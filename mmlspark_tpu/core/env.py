@@ -1,0 +1,69 @@
+"""Accelerator / environment discovery.
+
+The reference discovers workers by shelling to ``nvidia-smi -L`` and counting
+lines (core/env/src/main/scala/EnvironmentUtils.scala:14-51); the worker count
+drives MPI parallelism (CommandBuilders.scala:81). The TPU-native equivalent
+is JAX device introspection — no subprocess, no parsing.
+"""
+
+from __future__ import annotations
+
+import platform
+from dataclasses import dataclass
+
+
+def device_count() -> int:
+    """Global accelerator count (EnvironmentUtils.GPUCount analog)."""
+    import jax
+
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()
+
+
+def process_count() -> int:
+    """Number of controller processes (multi-host)."""
+    import jax
+
+    return jax.process_count()
+
+
+def backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def is_tpu() -> bool:
+    return backend() == "tpu"
+
+
+@dataclass(frozen=True)
+class TopologyInfo:
+    """TPU topology introspection summary (replaces the reference's
+    single-node GPU-count worldview with mesh-shaped facts)."""
+
+    num_devices: int
+    num_local_devices: int
+    num_processes: int
+    platform: str
+    device_kind: str
+    host_os: str
+
+
+def topology() -> TopologyInfo:
+    import jax
+
+    devs = jax.devices()
+    return TopologyInfo(
+        num_devices=len(devs),
+        num_local_devices=jax.local_device_count(),
+        num_processes=jax.process_count(),
+        platform=jax.default_backend(),
+        device_kind=devs[0].device_kind if devs else "none",
+        host_os=platform.system(),
+    )

@@ -1,0 +1,16 @@
+"""The pipeline-stage surface: importing this package registers every stage.
+
+Mirrors the reference's per-capability sbt sub-projects (SURVEY.md §2.3-2.7);
+each module here corresponds to one or more reference modules and the import
+below is what populates :meth:`PipelineStage.registry` (the analog of
+JarLoadingUtils loading every Transformer/Estimator from built jars).
+"""
+
+_STAGE_MODULES = [
+    # populated as stage modules land; each entry is imported eagerly below
+]
+
+import importlib
+
+for _m in _STAGE_MODULES:
+    importlib.import_module(f"mmlspark_tpu.stages.{_m}")

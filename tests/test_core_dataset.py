@@ -1,0 +1,130 @@
+"""Dataset tests — the DataFrame-replacement semantics everything rests on."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import SchemaError
+from mmlspark_tpu.core.schema import (
+    ColumnMeta,
+    LABEL_KIND,
+    SCORED_LABELS_KIND,
+    CategoricalMeta,
+    find_label_column,
+    find_scored_labels_column,
+    fresh_column_name,
+    tag_column,
+    CLASSIFICATION,
+    get_score_value_kind,
+)
+from mmlspark_tpu.data.dataset import Dataset
+
+
+def test_basic_shape(basic_dataset):
+    assert basic_dataset.num_rows == 4
+    assert set(basic_dataset.columns) == {"numbers", "doubles", "words", "flags"}
+    assert basic_dataset["numbers"].dtype == np.int64
+    assert basic_dataset["words"].dtype == object
+
+
+def test_length_mismatch_rejected():
+    with pytest.raises(SchemaError):
+        Dataset({"a": [1, 2], "b": [1, 2, 3]})
+
+
+def test_select_drop_rename(basic_dataset):
+    sel = basic_dataset.select("numbers", "words")
+    assert sel.columns == ["numbers", "words"]
+    dropped = basic_dataset.drop("flags")
+    assert "flags" not in dropped
+    ren = basic_dataset.rename({"numbers": "ints"})
+    assert "ints" in ren and "numbers" not in ren
+    # original untouched (immutability)
+    assert "numbers" in basic_dataset
+
+
+def test_with_column_and_meta(basic_dataset):
+    meta = ColumnMeta(categorical=CategoricalMeta(("a", "b")))
+    ds = basic_dataset.with_column("cat", ["a", "b", "a", "b"], meta)
+    assert ds.meta_of("cat").categorical.num_levels == 2
+    with pytest.raises(SchemaError):
+        basic_dataset.with_column("bad", [1, 2])
+
+
+def test_filter_take_gather(basic_dataset):
+    f = basic_dataset.filter(basic_dataset["numbers"] > 1)
+    assert f.num_rows == 2 and list(f["words"]) == ["bass", "keys"]
+    assert basic_dataset.take(2).num_rows == 2
+    g = basic_dataset.gather(np.array([3, 0]))
+    assert list(g["words"]) == ["keys", "guitars"]
+
+
+def test_sample_deterministic(basic_dataset):
+    a = basic_dataset.sample(fraction=0.5, seed=7)
+    b = basic_dataset.sample(fraction=0.5, seed=7)
+    assert list(a["numbers"]) == list(b["numbers"])
+    assert a.num_rows == 2
+
+
+def test_concat_and_vector_columns():
+    d1 = Dataset({"v": np.ones((2, 3)), "s": ["x", "y"]})
+    d2 = Dataset({"v": np.zeros((1, 3)), "s": ["z"]})
+    cat = Dataset.concat([d1, d2])
+    assert cat.num_rows == 3 and cat["v"].shape == (3, 3)
+    with pytest.raises(SchemaError):
+        Dataset.concat([d1, d1.rename({"v": "w"})])
+
+
+def test_ragged_object_column():
+    ds = Dataset({"seq": [np.arange(2), np.arange(5), np.arange(1)]})
+    assert ds["seq"].dtype == object
+    assert len(ds["seq"][1]) == 5
+
+
+def test_pandas_round_trip(basic_dataset):
+    df = basic_dataset.to_pandas()
+    back = Dataset.from_pandas(df)
+    assert back.num_rows == 4
+    assert list(back["words"]) == list(basic_dataset["words"])
+
+
+def test_map_column(basic_dataset):
+    ds = basic_dataset.map_column("words", str.upper, output="loud")
+    assert list(ds["loud"]) == ["GUITARS", "DRUMS", "BASS", "KEYS"]
+
+
+def test_score_column_protocol(basic_dataset):
+    ds = basic_dataset.with_meta(
+        "numbers", tag_column(None, LABEL_KIND, "m1", CLASSIFICATION)
+    ).with_meta("flags", tag_column(None, SCORED_LABELS_KIND, "m1", CLASSIFICATION))
+    assert find_label_column(ds) == "numbers"
+    assert find_scored_labels_column(ds, "m1") == "flags"
+    assert get_score_value_kind(ds, "m1") == CLASSIFICATION
+    assert find_label_column(ds, "other") is None
+
+
+def test_fresh_column_name(basic_dataset):
+    assert fresh_column_name(basic_dataset, "new") == "new"
+    assert fresh_column_name(basic_dataset, "numbers") == "numbers_1"
+
+
+def test_partitions(basic_dataset):
+    ds = basic_dataset.with_partitions(4)
+    assert ds.num_partitions == 4
+    assert basic_dataset.num_partitions == 1
+
+
+def test_rename_collision_rejected(basic_dataset):
+    with pytest.raises(SchemaError):
+        basic_dataset.rename({"numbers": "doubles"})
+
+
+def test_with_column_replacement_resets_meta(basic_dataset):
+    tagged = basic_dataset.with_meta(
+        "numbers", ColumnMeta(categorical=CategoricalMeta(("a", "b")))
+    )
+    replaced = tagged.with_column("numbers", np.zeros(4))
+    assert replaced.meta_of("numbers").is_empty()
+    kept = tagged.with_column(
+        "numbers", np.zeros(4), tagged.meta_of("numbers")
+    )
+    assert kept.meta_of("numbers").categorical is not None

@@ -1,0 +1,85 @@
+"""Param system tests (reference behavior: core/contracts/Params.scala,
+exercised by VerifyMMLParams-style suites)."""
+
+import pytest
+
+from mmlspark_tpu.core.exceptions import ParamError
+from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, Param, positive
+from mmlspark_tpu.core.stage import Transformer
+
+
+class _Toy(Transformer, HasInputCol, HasOutputCol):
+    n = Param("a positive int", 3, ptype=int, validator=positive)
+    mode = Param("string-enum domain", "mean", domain=("mean", "median", "custom"))
+
+    def _transform(self, ds):
+        return ds
+
+
+def test_defaults_and_set():
+    t = _Toy()
+    assert t.n == 3
+    assert t.input_col == "input"
+    t.set(n=5, input_col="x")
+    assert t.n == 5 and t.input_col == "x"
+    assert t.is_set("n") and not t.is_set("mode")
+
+
+def test_chainable_set_returns_self():
+    t = _Toy()
+    assert t.set(n=7) is t
+
+
+def test_type_check():
+    with pytest.raises(ParamError):
+        _Toy().set(n="seven")
+    # float->int accepted only for numeric widening on declared numeric params
+    t = _Toy().set(n=4)
+    assert isinstance(t.n, int)
+
+
+def test_bool_not_int():
+    with pytest.raises(ParamError):
+        _Toy().set(n=True)
+
+
+def test_domain_enforced():
+    t = _Toy()
+    t.set(mode="median")
+    with pytest.raises(ParamError):
+        t.set(mode="bogus")
+
+
+def test_validator():
+    with pytest.raises(ParamError):
+        _Toy().set(n=0)
+
+
+def test_unknown_param_rejected():
+    with pytest.raises(ParamError):
+        _Toy().set(nope=1)
+
+
+def test_params_table_includes_mixins():
+    names = set(_Toy.params())
+    assert {"n", "mode", "input_col", "output_col"} <= names
+
+
+def test_copy_preserves_explicit_values_only():
+    t = _Toy().set(n=9)
+    c = t.copy()
+    assert c.n == 9 and not c.is_set("mode")
+    assert c.uid != t.uid
+    c2 = t.copy(n=11)
+    assert c2.n == 11 and t.n == 9
+
+
+def test_explain_params_mentions_domain():
+    text = _Toy().explain_params()
+    assert "median" in text and "positive int" in text
+
+
+def test_uids_unique_and_prefixed():
+    a, b = _Toy(), _Toy()
+    assert a.uid != b.uid
+    assert a.uid.startswith("_Toy")
