@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterable, Sequence
 
+import numpy as np
+
 from mmlspark_tpu.core.exceptions import ParamError
 
 
@@ -59,6 +61,9 @@ class Param:
     def validate(self, value: Any, uid: str | None = None) -> Any:
         if value is None:
             return value
+        if isinstance(value, (np.integer, np.floating, np.bool_)):
+            # numpy scalars flow in naturally from Dataset columns
+            value = value.item()
         if self.ptype is not None:
             # bool is an int subclass; keep int params from accepting True.
             if isinstance(value, bool) and self.ptype in (int, float):
@@ -96,7 +101,12 @@ class Param:
             return self
         if self.name in obj._param_values:
             return obj._param_values[self.name]
-        return self.get_default()
+        default = self.get_default()
+        if callable(self.default):
+            # Materialize mutable defaults on first access so in-place
+            # mutation (pipe.stages.append(...)) is not silently discarded.
+            obj._param_values[self.name] = default
+        return default
 
     def __set__(self, obj, value) -> None:
         obj._param_values[self.name] = self.validate(value, getattr(obj, "uid", None))

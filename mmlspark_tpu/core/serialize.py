@@ -201,7 +201,10 @@ def load_stage(path: str) -> PipelineStage:
     if cls_name not in registry:
         raise MMLError(f"unknown stage class '{cls_name}' (not registered)")
     arrays_path = os.path.join(path, "arrays.npz")
-    arrays = np.load(arrays_path, allow_pickle=True) if os.path.exists(arrays_path) else {}
+    arrays: dict[str, np.ndarray] = {}
+    if os.path.exists(arrays_path):
+        with np.load(arrays_path, allow_pickle=True) as z:
+            arrays = {k: z[k] for k in z.files}
     dec = _Decoder(path, arrays)
     stage = registry[cls_name]()
     stage.uid = spec["uid"]
@@ -259,11 +262,10 @@ def load_dataset(path: str) -> Dataset:
     if os.path.exists(obj_path):
         with np.load(obj_path, allow_pickle=True) as z:
             cols.update({k.removeprefix(_COL_PREFIX): z[k] for k in z.files})
-    meta_arrays = (
-        np.load(meta_arrays_path, allow_pickle=True)
-        if os.path.exists(meta_arrays_path)
-        else {}
-    )
+    meta_arrays: dict[str, np.ndarray] = {}
+    if os.path.exists(meta_arrays_path):
+        with np.load(meta_arrays_path, allow_pickle=True) as z:
+            meta_arrays = {k: z[k] for k in z.files}
     dec = _Decoder(path, meta_arrays)
     col_meta = {name: dec.decode(v) for name, v in meta.get("meta", {}).items()}
     ordered = {name: cols[name] for name in meta["columns"]}

@@ -140,18 +140,26 @@ class Pipeline(Estimator):
             self.stages = list(stages)
 
     def _fit(self, dataset: Dataset) -> "PipelineModel":
+        stages = list(self.stages)
+        last_estimator = max(
+            (i for i, s in enumerate(stages) if isinstance(s, Estimator)),
+            default=-1,
+        )
         fitted: list[Transformer] = []
         current = dataset
-        for stage in self.stages:
+        for i, stage in enumerate(stages):
             if isinstance(stage, Estimator):
                 model = stage.fit(current)
-                fitted.append(model)
-                current = model.transform(current)
             elif isinstance(stage, Transformer):
-                fitted.append(stage)
-                current = stage.transform(current)
+                model = stage
             else:
                 raise TypeError(f"not a pipeline stage: {stage!r}")
+            fitted.append(model)
+            # No later estimator needs the transformed data — skip the pass
+            # (matches Spark ML Pipeline.fit; avoids a wasted full-dataset
+            # inference when the last stage is an expensive model).
+            if i < last_estimator:
+                current = model.transform(current)
         return PipelineModel(stages=fitted)
 
 

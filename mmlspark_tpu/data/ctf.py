@@ -1,0 +1,103 @@
+"""CNTK-text-format (CTF) read/write.
+
+The reference exports training data as CTF lines
+``|<label_name> v ... |<features_name> i:v ...`` before launching the external
+trainer (cntk-train/src/main/scala/DataConversion.scala:86-96
+``convertDatasetToCNTKTextFormat``; dense ``toDense`` / sparse ``toSparse``
+forms). The TPU framework trains in-process so no file round-trip is needed,
+but the format is kept for data interchange with reference-era corpora.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.data.dataset import Dataset
+
+DENSE = "dense"
+SPARSE = "sparse"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def dataset_to_ctf_lines(
+    dataset: Dataset,
+    label_col: str = "label",
+    features_col: str = "features",
+    label_form: str = DENSE,
+    features_form: str = SPARSE,
+) -> list[str]:
+    dataset.require(label_col, features_col)
+    labels = dataset[label_col]
+    feats = dataset[features_col]
+    lines = []
+    for i in range(dataset.num_rows):
+        lab = np.atleast_1d(np.asarray(labels[i], dtype=float))
+        if label_form == DENSE:
+            lab_txt = " ".join(_fmt(v) for v in lab)
+        else:
+            lab_txt = " ".join(f"{j}:{_fmt(v)}" for j, v in enumerate(lab) if v != 0)
+        fv = np.asarray(feats[i], dtype=float).ravel()
+        if features_form == DENSE:
+            feat_txt = " ".join(_fmt(v) for v in fv)
+        else:
+            nz = np.nonzero(fv)[0]
+            feat_txt = " ".join(f"{j}:{_fmt(fv[j])}" for j in nz)
+        lines.append(f"|{label_col} {lab_txt} |{features_col} {feat_txt}")
+    return lines
+
+
+def write_ctf(dataset: Dataset, path: str, **kwargs) -> None:
+    with open(path, "w") as f:
+        for line in dataset_to_ctf_lines(dataset, **kwargs):
+            f.write(line + "\n")
+
+
+def read_ctf(
+    path: str,
+    feature_dim: int | None = None,
+    label_col: str = "label",
+    features_col: str = "features",
+) -> Dataset:
+    """Parse CTF lines back into (label, features) columns. Sparse features
+    require ``feature_dim`` to densify; dense streams infer their width."""
+    labels: list[np.ndarray] = []
+    feats: list[np.ndarray] = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            fields: dict[str, str] = {}
+            for chunk in raw.split("|")[1:]:
+                name, _, rest = chunk.partition(" ")
+                fields[name] = rest.strip()
+            if label_col not in fields or features_col not in fields:
+                raise FriendlyError(
+                    f"CTF line missing |{label_col} or |{features_col}: {raw[:80]}"
+                )
+            labels.append(_parse_values(fields[label_col], None))
+            feats.append(_parse_values(fields[features_col], feature_dim))
+    lab_arr = np.stack(labels) if labels else np.zeros((0, 1))
+    if lab_arr.shape[1] == 1:
+        lab_arr = lab_arr[:, 0]
+    return Dataset({label_col: lab_arr, features_col: np.stack(feats)})
+
+
+def _parse_values(text: str, dim: int | None) -> np.ndarray:
+    toks = text.split()
+    if not toks:
+        return np.zeros(dim or 0)
+    if ":" in toks[0]:
+        if dim is None:
+            raise FriendlyError("sparse CTF needs feature_dim to densify")
+        out = np.zeros(dim)
+        for t in toks:
+            j, _, v = t.partition(":")
+            out[int(j)] = float(v)
+        return out
+    return np.asarray([float(t) for t in toks])

@@ -104,18 +104,10 @@ class Dataset:
                 raise SchemaError(
                     f"concat schema mismatch: {names} vs {list(d.columns)}"
                 )
-        cols = {}
-        for name in names:
-            parts = [d._columns[name] for d in datasets]
-            if any(p.dtype == object for p in parts):
-                out = np.empty(sum(len(p) for p in parts), dtype=object)
-                i = 0
-                for p in parts:
-                    out[i : i + len(p)] = p
-                    i += len(p)
-                cols[name] = out
-            else:
-                cols[name] = np.concatenate(parts, axis=0)
+        cols = {
+            name: np.concatenate([d._columns[name] for d in datasets], axis=0)
+            for name in names
+        }
         return Dataset(cols, first._meta, first.num_partitions)
 
     # -- basic accessors ----------------------------------------------------

@@ -1,0 +1,77 @@
+"""Build + load machinery for the native decode library.
+
+Plays the role of the reference's ``NativeLoader``
+(core/env/src/main/scala/NativeLoader.java: extract shared lib from jar
+resources, ``System.load`` once per JVM): here we compile ``decode.cpp`` with
+the system toolchain on first use, cache the ``.so`` next to the source, and
+``ctypes.CDLL`` it once per process.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger("native")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_SRC_DIR, "decode.cpp")
+_SO = os.path.join(_SRC_DIR, "libmmlimg.so")
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        _SRC, "-o", _SO, "-ljpeg", "-lpng",
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no toolchain
+        _log.warning("native decode build unavailable: %s", e)
+        return False
+    if res.returncode != 0:
+        _log.warning("native decode build failed:\n%s", res.stderr[-2000:])
+        return False
+    return True
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Compile-if-needed and dlopen the decode library; None if unavailable
+    (callers fall back to a pure-Python decoder)."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _compile():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            _log.warning("native decode load failed: %s", e)
+            _build_failed = True
+            return None
+        lib.mml_decode_image.restype = ctypes.c_int
+        lib.mml_decode_image.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.mml_free.restype = None
+        lib.mml_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.mml_decoder_version.restype = ctypes.c_char_p
+        _lib = lib
+        return _lib

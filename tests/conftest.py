@@ -3,18 +3,31 @@
 Unit tests run on a virtual 8-device CPU mesh — the JAX analog of the
 reference's shared ``local[*]`` SparkSession per suite
 (core/test/base/src/main/scala/SparkSessionFactory.scala:40-51): multi-worker
-parallelism exercised in one process, no real pod needed. The env vars must be
-set before jax initializes its backends, hence module top-level.
+parallelism exercised in one process, no real pod needed.
+
+The interpreter may import jax at startup (site customization registering a
+real TPU backend), so env vars alone are not enough: we set XLA_FLAGS before
+the first backend initialization and force the platform through jax.config.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import re
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    "tests require the virtual 8-device CPU mesh; backend was initialized "
+    f"too early (got {jax.devices()})"
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -151,3 +151,41 @@ def test_int_param_rejects_fractional_float():
     with pytest.raises(ParamError):
         P().set(n=2.7)
     assert P().set(n=2.0).n == 2
+
+
+def test_pipeline_stages_append_not_discarded(basic_dataset):
+    p = Pipeline()
+    p.stages.append(AddConstant(amount=4.0))
+    out = p.fit(basic_dataset).transform(basic_dataset)
+    assert list(out["plus"]) == [4, 5, 6, 7]
+
+
+def test_numpy_scalar_param_accepted():
+    stage = AddConstant().set(amount=np.float64(2.5))
+    assert stage.amount == 2.5 and isinstance(stage.amount, float)
+
+    class Counted(Transformer):
+        n = Param("count", 0, ptype=int)
+
+        def _transform(self, ds):
+            return ds
+
+    assert Counted().set(n=np.int64(5)).n == 5
+
+
+def test_pipeline_skips_transform_after_last_estimator(basic_dataset):
+    calls = []
+
+    class Spy(Transformer):
+        def _transform(self, ds):
+            calls.append("t")
+            return ds
+
+    class SpyEst(Estimator):
+        def _fit(self, ds):
+            return Spy()
+
+    Pipeline([SpyEst(), Spy()]).fit(basic_dataset)
+    # neither the fitted model of the last estimator nor the trailing
+    # transformer should have run during fit
+    assert calls == []

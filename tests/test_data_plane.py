@@ -1,0 +1,200 @@
+"""Data-plane tests: decode op, readers (zip traversal, seeded subsample),
+CTF format, fixed-shape batch feed."""
+
+import io
+import os
+import zipfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mmlspark_tpu.core.exceptions import SchemaError
+from mmlspark_tpu.data.ctf import dataset_to_ctf_lines, read_ctf, write_ctf
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.data.feed import (
+    MASK_COL,
+    batch_iterator,
+    bucket_by_length,
+    stack_column,
+    to_device,
+)
+from mmlspark_tpu.data.readers import (
+    read_binary_files,
+    read_csv,
+    read_images,
+    stream_images,
+)
+from mmlspark_tpu.ops.decode import decode_image, native_available
+
+
+def _write_image(path, h=8, w=6, color=(10, 20, 30), fmt="PNG"):
+    rgb = np.zeros((h, w, 3), np.uint8)
+    rgb[:] = color
+    Image.fromarray(rgb).save(path, fmt)
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    d = tmp_path / "imgs"
+    d.mkdir()
+    _write_image(d / "a.png", color=(255, 0, 0))
+    _write_image(d / "b.jpg", fmt="JPEG", color=(0, 255, 0))
+    sub = d / "sub"
+    sub.mkdir()
+    _write_image(sub / "c.png", color=(0, 0, 255))
+    (d / "notes.txt").write_bytes(b"not an image")
+    return str(d)
+
+
+def test_native_decoder_builds():
+    # The production path is the C++ op; the toolchain is in the image.
+    assert native_available()
+
+
+def test_decode_bgr_convention():
+    buf = io.BytesIO()
+    rgb = np.zeros((4, 5, 3), np.uint8)
+    rgb[..., 0] = 200  # pure red
+    Image.fromarray(rgb).save(buf, "PNG")
+    out = decode_image(buf.getvalue())
+    assert out.shape == (4, 5, 3)
+    assert out[0, 0, 2] == 200 and out[0, 0, 0] == 0  # red lands in channel 2
+
+
+def test_read_binary_files_recursive(image_dir):
+    ds = read_binary_files(image_dir)
+    assert ds.num_rows == 4  # includes notes.txt
+    assert all(isinstance(b, bytes) for b in ds["bytes"])
+    flat = read_binary_files(image_dir, recursive=False)
+    assert flat.num_rows == 3
+
+
+def test_read_images_drops_non_decodable(image_dir):
+    ds = read_images(image_dir)
+    assert ds.num_rows == 3  # notes.txt dropped
+    row = ds["image"][0]
+    assert row.data.dtype == np.uint8 and row.channels == 3
+    assert ds.meta_of("image").image is not None
+
+
+def test_zip_traversal(tmp_path):
+    zpath = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.writestr("one.txt", b"alpha")
+        zf.writestr("nested/two.txt", b"beta")
+    ds = read_binary_files(str(tmp_path))
+    assert ds.num_rows == 2
+    assert any(p.endswith("nested/two.txt") for p in ds["path"])
+
+
+def test_seeded_subsample_deterministic(tmp_path):
+    d = tmp_path / "many"
+    d.mkdir()
+    for i in range(60):
+        (d / f"f{i:03d}.bin").write_bytes(bytes([i]))
+    a = read_binary_files(str(d), sample_ratio=0.5, seed=7)
+    b = read_binary_files(str(d), sample_ratio=0.5, seed=7)
+    assert list(a["path"]) == list(b["path"])
+    assert 10 < a.num_rows < 50
+    c = read_binary_files(str(d), sample_ratio=0.5, seed=8)
+    assert list(c["path"]) != list(a["path"])
+    # per-file decision is independent of the listing -> subset relation holds
+    sub = read_binary_files(str(d), sample_ratio=0.25, seed=7)
+    assert sub.num_rows < a.num_rows
+
+
+def test_stream_images_chunks(image_dir):
+    chunks = list(stream_images(image_dir, chunk_rows=2))
+    assert sum(c.num_rows for c in chunks) == 3
+    assert chunks[0].num_rows == 2
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("x,y\n1,a\n2,b\n")
+    ds = read_csv(str(p))
+    assert ds.num_rows == 2 and list(ds["y"]) == ["a", "b"]
+
+
+def test_ctf_round_trip():
+    ds = Dataset(
+        {
+            "label": np.array([0.0, 1.0, 2.0]),
+            "features": np.array(
+                [[0.0, 1.5, 0.0, 2.0], [3.0, 0.0, 0.0, 0.0], [0.0, 0.0, 0.25, 1.0]]
+            ),
+        }
+    )
+    lines = dataset_to_ctf_lines(ds)
+    assert lines[0] == "|label 0 |features 1:1.5 3:2"
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "data.ctf")
+        write_ctf(ds, path)
+        back = read_ctf(path, feature_dim=4)
+        np.testing.assert_allclose(back["features"], ds["features"])
+        np.testing.assert_allclose(back["label"], ds["label"])
+
+
+def test_ctf_dense_features():
+    ds = Dataset({"label": np.array([1.0]), "features": np.array([[1.0, 0.0, 2.5]])})
+    (line,) = dataset_to_ctf_lines(ds, features_form="dense")
+    assert line == "|label 1 |features 1 0 2.5"
+
+
+def test_batch_iterator_fixed_shapes():
+    ds = Dataset({"x": np.arange(10, dtype=np.float32).reshape(10, 1)})
+    batches = list(batch_iterator(ds, ["x"], batch_size=4))
+    assert len(batches) == 3
+    for b in batches:
+        assert b["x"].shape == (4, 1)  # tail padded — shape stable
+    assert batches[-1][MASK_COL].sum() == 2
+    dropped = list(batch_iterator(ds, ["x"], batch_size=4, drop_remainder=True))
+    assert len(dropped) == 2
+
+
+def test_batch_iterator_shuffle_deterministic():
+    ds = Dataset({"x": np.arange(8)})
+    a = [b["x"] for b in batch_iterator(ds, ["x"], 8, shuffle_seed=3)]
+    b = [b["x"] for b in batch_iterator(ds, ["x"], 8, shuffle_seed=3)]
+    np.testing.assert_array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], np.arange(8))
+
+
+def test_stack_column_object_vectors():
+    ds = Dataset({"v": [np.ones(3), np.zeros(3)]})
+    out = stack_column(ds, "v")
+    assert out.shape == (2, 3)
+    ragged = Dataset({"v": [np.ones(3), np.zeros(5)]})
+    with pytest.raises(SchemaError):
+        stack_column(ragged, "v")
+
+
+def test_bucket_by_length():
+    ds = Dataset(
+        {"seq": [np.ones(2), np.ones(7), np.ones(3), np.ones(8)], "id": [0, 1, 2, 3]}
+    )
+    groups = bucket_by_length(ds, "seq", [4, 8])
+    assert [b for b, _ in groups] == [4, 8]
+    b4 = dict(groups)[4]
+    assert b4["seq"].shape == (2, 4)  # padded to bucket
+    assert list(b4["id"]) == [0, 2]
+    with pytest.raises(SchemaError):
+        bucket_by_length(ds, "seq", [4])
+
+
+def test_to_device_sharded():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("data",))
+    from mmlspark_tpu.data.feed import data_sharding
+
+    batch = {"x": np.arange(16.0).reshape(16, 1)}
+    out = to_device(batch, data_sharding(mesh))
+    assert out["x"].shape == (16, 1)
+    assert len(out["x"].sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(out["x"]), batch["x"])
