@@ -7,7 +7,15 @@ JarLoadingUtils loading every Transformer/Estimator from built jars).
 """
 
 _STAGE_MODULES = [
-    # populated as stage modules land; each entry is imported eagerly below
+    "dnn_model",
+    "dnn_learner",
+    "value_indexer",
+    "featurize",
+    "text",
+    "train_classifier",
+    "train_regressor",
+    "eval_metrics",
+    "find_best",
 ]
 
 import importlib

@@ -1,0 +1,111 @@
+"""TPUModel inference stage tests (reference behavior: CNTKModelSuite +
+fuzzing serialization invariants for the DNN stage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.models import build_model
+from mmlspark_tpu.stages.dnn_model import TPUModel
+
+
+@pytest.fixture(scope="module")
+def mlp_model():
+    g = build_model("mlp", num_outputs=3, hidden=(8,))
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    return TPUModel.from_graph(
+        g, v, "mlp",
+        model_config={"num_outputs": 3, "hidden": (8,)},
+        input_col="features", output_col="scores", batch_size=4,
+    )
+
+
+def _feature_ds(n=10, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset({"features": rng.normal(size=(n, d)).astype(np.float64),
+                    "idx": np.arange(n)})
+
+
+def test_batched_inference_matches_direct(mlp_model):
+    ds = _feature_ds(n=10)
+    out = mlp_model.transform(ds)
+    assert out["scores"].shape == (10, 3)
+    # row count not divisible by batch_size=4 -> padding trimmed correctly
+    direct = mlp_model.graph().apply(
+        mlp_model.weights, jnp.asarray(ds["features"], jnp.float32)
+    )
+    np.testing.assert_allclose(out["scores"], np.asarray(direct), rtol=2e-2,
+                               atol=1e-2)
+    # input dataset columns preserved
+    assert list(out["idx"]) == list(range(10))
+
+
+def test_batch_invariance(mlp_model):
+    """Same rows, different batch sizes -> same scores (the reference's
+    minibatch semantics: batching is an execution detail)."""
+    ds = _feature_ds(n=7)
+    a = mlp_model.copy().set(batch_size=2).transform(ds)["scores"]
+    b = mlp_model.copy().set(batch_size=16).transform(ds)["scores"]
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-2)
+
+
+def test_output_node_cut(mlp_model):
+    ds = _feature_ds(n=5)
+    headless = mlp_model.copy().set(output_node="hidden1")
+    out = headless.transform(ds)
+    assert out["scores"].shape == (5, 8)  # hidden activations as features
+
+
+def test_object_vector_column_coerced(mlp_model):
+    ds = Dataset({"features": [np.zeros(4), np.ones(4), np.full(4, 2.0)]})
+    out = mlp_model.transform(ds)
+    assert out["scores"].shape == (3, 3)
+
+
+def test_missing_weights_friendly_error():
+    stage = TPUModel(model_name="mlp", input_col="features")
+    with pytest.raises(FriendlyError):
+        stage.transform(_feature_ds())
+
+
+def test_ragged_input_friendly_error(mlp_model):
+    ds = Dataset({"features": [np.zeros(3), np.zeros(4)]})
+    with pytest.raises(FriendlyError):
+        mlp_model.transform(ds)
+
+
+def test_round_trip_identical_scores(tmp_path, mlp_model):
+    ds = _feature_ds(n=6)
+    mlp_model.save(str(tmp_path / "m"))
+    loaded = PipelineStage.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        loaded.transform(ds)["scores"], mlp_model.transform(ds)["scores"]
+    )
+
+
+def test_set_model_location(tmp_path, mlp_model):
+    mlp_model.save(str(tmp_path / "loc"))
+    fresh = TPUModel(input_col="features", output_col="scores",
+                     model_name="mlp").set_model_location(str(tmp_path / "loc"))
+    out = fresh.transform(_feature_ds(n=3))
+    assert out["scores"].shape == (3, 3)
+
+
+def test_resnet_inference_sharded_over_mesh():
+    """CIFAR-shaped end-to-end inference across the 8-device CPU mesh."""
+    g = build_model("resnet20_cifar10", width=8)
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    stage = TPUModel.from_graph(
+        g, v, "resnet20_cifar10", model_config={"width": 8},
+        input_col="image", output_col="scores", batch_size=16,
+    )
+    rng = np.random.default_rng(0)
+    ds = Dataset({"image": rng.normal(size=(10, 32, 32, 3)).astype(np.float32)})
+    out = stage.transform(ds)
+    assert out["scores"].shape == (10, 10)
+    preds = np.argmax(out["scores"], axis=1)
+    assert preds.shape == (10,)

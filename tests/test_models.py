@@ -1,0 +1,77 @@
+"""Model graph + registry tests: named nodes, cut-at-node, train mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.models import build_model, registered_models
+from mmlspark_tpu.models.graph import FINAL_NODE
+
+
+def test_registry_lists_families():
+    names = registered_models()
+    for expected in ("resnet20_cifar10", "resnet50", "mlp", "linear",
+                     "bilstm_tagger"):
+        assert expected in names
+    with pytest.raises(FriendlyError):
+        build_model("nope")
+
+
+def test_resnet20_shapes_and_nodes():
+    g = build_model("resnet20_cifar10")
+    assert g.layer_names == ["stem", "stage1", "stage2", "stage3", "pool", "z"]
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    out = g.apply(v, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10) and out.dtype == jnp.float32
+    feats = g.apply(v, jnp.zeros((2, 32, 32, 3)), output_node="pool")
+    assert feats.shape == (2, 64)
+    by_index = g.apply(v, jnp.zeros((2, 32, 32, 3)), output_node=4)
+    np.testing.assert_allclose(np.asarray(by_index), np.asarray(feats))
+
+
+def test_cut_produces_prefix_graph():
+    g = build_model("resnet20_cifar10")
+    head = g.cut("pool")
+    assert head.layer_names == ["stem", "stage1", "stage2", "stage3", "pool"]
+    with pytest.raises(FriendlyError):
+        g.cut("not_a_node")
+
+
+def test_train_mode_updates_batch_stats():
+    g = build_model("resnet20_cifar10", width=8)
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    out, updated = g.apply(v, x, train=True)
+    assert out.shape == (4, 10)
+    before = jax.tree_util.tree_leaves(
+        {k: s.get("batch_stats") for k, s in v.items() if "batch_stats" in s}
+    )
+    after = jax.tree_util.tree_leaves(
+        {k: s.get("batch_stats") for k, s in updated.items() if "batch_stats" in s}
+    )
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(before, after)
+    )
+
+
+def test_bilstm_token_logits():
+    g = build_model("bilstm_tagger", vocab_size=30, embed_dim=8, hidden=8,
+                    num_tags=4)
+    ids = jnp.array([[1, 2, 3], [4, 5, 6]], dtype=jnp.int32)
+    v = g.init(jax.random.PRNGKey(0), ids)
+    out = g.apply(v, ids)
+    assert out.shape == (2, 3, 4)
+    # backward direction sees the future: changing last token changes first
+    # token's logits
+    ids2 = ids.at[0, 2].set(7)
+    out2 = g.apply(v, ids2)
+    assert not np.allclose(np.asarray(out[0, 0]), np.asarray(out2[0, 0]))
+
+
+def test_final_node_convention():
+    for name in ("mlp", "linear", "resnet20_cifar10", "bilstm_tagger"):
+        g = build_model(name)
+        assert g.layer_names[-1] == FINAL_NODE
