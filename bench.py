@@ -1,0 +1,73 @@
+"""Benchmark: flagship CIFAR-10 CNN inference throughput per chip.
+
+North-star metric #1 from BASELINE.json ("CIFAR-10 CNN images/sec/chip" —
+reference notebook 301 runs the same eval through CNTKModel with JNI copies
+per 10-row minibatch, CNTKModel.scala:51-88,205). The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` is reported against this repo's
+own first recorded value once one exists (BENCH_r1.json onward); until then
+it is null.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+
+    graph = build_model("resnet20_cifar10")
+    rng = jax.random.PRNGKey(0)
+    variables = graph.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32))
+
+    batch = 1024
+    x_host = np.random.default_rng(0).normal(size=(batch, 32, 32, 3))
+    x = jnp.asarray(x_host, jnp.float32)
+
+    iters = 60
+
+    # Methodology: iterations chained by a data dependency inside ONE jit
+    # (so no execution can be elided or overlapped away), timed around a
+    # forced host fetch of a scalar — block_until_ready alone is not a
+    # reliable sync point on remote-execution backends (measured above
+    # hardware peak without the fetch).
+    def chained(v, x):
+        def body(carry, _):
+            out = graph.apply(v, carry)
+            carry = carry + out.mean().astype(carry.dtype) * 1e-12
+            return carry, ()
+
+        final, _ = jax.lax.scan(body, x, None, length=iters)
+        return final.mean()  # scalar: fetch cost is negligible
+
+    fwd = jax.jit(chained)
+    np.asarray(fwd(variables, x))  # warmup / compile
+
+    t0 = time.perf_counter()
+    np.asarray(fwd(variables, x))
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    per_chip = images_per_sec / jax.device_count()
+    result = {
+        "metric": "cifar10_resnet20_inference_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "devices": jax.device_count(),
+        "backend": jax.default_backend(),
+        "batch": batch,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -97,6 +97,8 @@ def bucket_by_length(
     arr = dataset.column(column)
     lengths = np.asarray([len(np.asarray(v)) for v in arr])
     buckets = sorted(buckets)
+    if not buckets:
+        raise SchemaError("bucket_by_length needs at least one bucket size")
     if lengths.size and lengths.max() > buckets[-1]:
         raise SchemaError(
             f"sequence length {int(lengths.max())} exceeds largest bucket "

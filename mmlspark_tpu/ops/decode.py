@@ -63,7 +63,10 @@ def decode_image(data: bytes) -> np.ndarray | None:
     if not isinstance(data, (bytes, bytearray)) or len(data) < 8:
         return None
     out = _decode_native(bytes(data))
-    if out is None and native_build.load_library() is None:
+    if out is None:
+        # Fall back to PIL for formats the native op doesn't cover (GIF,
+        # TIFF, WebP, CMYK JPEG, ...) so row counts do not depend on
+        # whether a toolchain was available.
         out = _decode_pil(bytes(data))
     return out
 

@@ -1,0 +1,15 @@
+"""Parallelism layer: device meshes, shardings, multi-host init.
+
+One backend replaces the reference's four transports (SURVEY.md §5
+"distributed communication backend": Spark RPC/broadcast/shuffle, MPI,
+py4j, JNI): single-controller JAX with XLA collectives compiled onto ICI
+within a slice and DCN across slices.
+"""
+
+from mmlspark_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_spec,
+    make_mesh,
+    replicated_spec,
+)

@@ -1,0 +1,97 @@
+"""Device mesh construction + sharding helpers.
+
+Replaces the reference's worker discovery (`nvidia-smi -L` count,
+EnvironmentUtils.scala:45-50) and MPI topology (hostfile ``slots=N``,
+CommandBuilders.scala:95-116) with a named :class:`jax.sharding.Mesh`:
+axis names are the API, XLA collectives ride ICI/DCN underneath (the
+scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+
+#: canonical axis names
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "seq"
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    devices: Sequence | None = None,
+):
+    """Build a Mesh over the visible devices.
+
+    ``axes`` maps axis name -> size, in major-to-minor order; a single axis
+    may be -1 (inferred). Default: pure data-parallel over every device —
+    the reference's only strategy (SURVEY.md §2.5), here just the trivial
+    mesh shape.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if axes is None:
+        axes = {DATA_AXIS: n}
+    names = list(axes)
+    sizes = list(axes.values())
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    if len(unknown) > 1:
+        raise FriendlyError("at most one mesh axis may be -1")
+    if unknown:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        if n % known:
+            raise FriendlyError(
+                f"cannot infer axis '{names[unknown[0]]}': {n} devices not "
+                f"divisible by {known}"
+            )
+        sizes[unknown[0]] = n // known
+    need = int(np.prod(sizes))
+    if need > n:
+        raise FriendlyError(
+            f"mesh {dict(zip(names, sizes))} needs {need} devices, have {n}"
+        )
+    # A smaller mesh uses the first `need` devices (e.g. debugging a
+    # single-chip layout on a pod).
+    grid = np.array(devs[:need]).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def batch_spec(mesh, axis: str = DATA_AXIS):
+    """NamedSharding splitting the leading (batch) dim over ``axis``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_spec(mesh):
+    """Fully-replicated NamedSharding (params under pure DP)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up (replaces MultiNodeParallelLauncher's MPI
+    hostfile, CommandBuilders.scala:95-116): every host runs the same
+    program; JAX wires the global device view over DCN."""
+    import jax
+
+    if coordinator_address is None:
+        return  # single-host: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

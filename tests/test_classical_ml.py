@@ -1,0 +1,271 @@
+"""Classical-ML layer tests: ValueIndexer, Featurize, TrainClassifier,
+TrainRegressor, evaluators, FindBestModel, TextFeaturizer (reference analog:
+VerifyTrainClassifier/VerifyComputeModelStatistics/VerifyFeaturize suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.stages.eval_metrics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    binary_auc,
+)
+from mmlspark_tpu.stages.featurize import AssembleFeatures, Featurize
+from mmlspark_tpu.stages.find_best import FindBestModel
+from mmlspark_tpu.stages.text import TextFeaturizer
+from mmlspark_tpu.stages.train_classifier import TrainClassifier
+from mmlspark_tpu.stages.train_regressor import TrainRegressor
+from mmlspark_tpu.stages.value_indexer import IndexToValue, ValueIndexer
+
+
+# -- ValueIndexer ------------------------------------------------------------
+
+
+def test_value_indexer_round_trip():
+    ds = Dataset({"cat": ["b", "a", None, "b", "c"]})
+    model = ValueIndexer(input_col="cat", output_col="idx").fit(ds)
+    assert model.levels == ["a", "b", "c"] and model.has_null
+    out = model.transform(ds)
+    assert list(out["idx"]) == [1, 0, 3, 1, 2]  # null -> trailing index
+    back = IndexToValue(input_col="idx", output_col="orig").transform(out)
+    assert list(back["orig"]) == ["b", "a", None, "b", "c"]
+
+
+def test_value_indexer_unseen_level():
+    model = ValueIndexer(input_col="cat", output_col="idx").fit(
+        Dataset({"cat": ["a", "b"]})
+    )
+    with pytest.raises(FriendlyError):
+        model.transform(Dataset({"cat": ["z"]}))
+
+
+def test_value_indexer_numeric_levels():
+    ds = Dataset({"n": np.array([30, 10, 20, 10])})
+    model = ValueIndexer(input_col="n", output_col="idx").fit(ds)
+    assert model.levels == [10, 20, 30]
+    assert list(model.transform(ds)["idx"]) == [2, 0, 1, 0]
+
+
+# -- Featurize ---------------------------------------------------------------
+
+
+def test_assemble_features_mixed_types():
+    ds = Dataset(
+        {
+            "num": np.array([1.0, 2.0, 3.0]),
+            "text": ["red apple", "green pear", "red pear"],
+            "flag": np.array([True, False, True]),
+        }
+    )
+    model = AssembleFeatures(number_of_features=64).fit(ds)
+    out = model.transform(ds)
+    feats = out["features"]
+    # 1 numeric + selected text slots (<=4 distinct tokens) + 1 bool
+    assert feats.shape[0] == 3
+    assert 4 <= feats.shape[1] <= 6
+    assert model.feature_dim == feats.shape[1]
+
+
+def test_featurize_na_drop():
+    ds = Dataset({"num": np.array([1.0, np.nan, 3.0]), "other": [10.0, 20.0, 30.0]})
+    out = Featurize().fit(ds).transform(ds)
+    assert out.num_rows == 2  # NaN row dropped
+
+
+def test_featurize_categorical_one_hot():
+    ds = Dataset({"cat": ["x", "y", "x"]})
+    indexed = ValueIndexer(input_col="cat", output_col="cat_idx").fit(ds).transform(ds)
+    sub = indexed.select("cat_idx")
+    out = AssembleFeatures().fit(sub).transform(sub)
+    np.testing.assert_array_equal(
+        out["features"], [[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]]
+    )
+
+
+def test_featurize_datetime():
+    ds = Dataset({"ts": np.array(["2017-06-04T10:30:00", "2018-01-01T00:00:00"],
+                                 dtype="datetime64[s]")})
+    model = AssembleFeatures(standardize=False).fit(ds)
+    feats = model.transform(ds)["features"]
+    assert feats.shape == (2, 7)
+    assert feats[0, 0] == 2017 and feats[1, 2] == 1  # year, month
+
+
+def test_featurize_standardization():
+    ds = Dataset({"num": np.array([10.0, 20.0, 30.0])})
+    out = AssembleFeatures().fit(ds).transform(ds)
+    col = out["features"][:, 0]
+    assert abs(col.mean()) < 1e-12 and abs(col.std() - 1.0) < 1e-12
+    raw = AssembleFeatures(standardize=False).fit(ds).transform(ds)
+    np.testing.assert_array_equal(raw["features"][:, 0], [10.0, 20.0, 30.0])
+
+
+# -- TrainClassifier / TrainRegressor ---------------------------------------
+
+
+def _census_like(n=240, seed=1):
+    """Mixed-type classification data (Adult-Census-like shape: numeric +
+    categorical strings, string label — notebook 101 config)."""
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(18, 80, n)
+    hours = rng.uniform(10, 60, n)
+    edu = rng.choice(["hs", "college", "phd"], n)
+    score = (age - 40) / 20 + (hours - 35) / 15 + (edu == "phd") * 1.5
+    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
+    return Dataset({
+        "age": age, "hours": hours, "education": list(edu),
+        "income": list(label),
+    })
+
+
+def test_train_classifier_end_to_end():
+    ds = _census_like()
+    model = TrainClassifier(label_col="income", epochs=25,
+                            learning_rate=5e-2).fit(ds)
+    out = model.transform(ds)
+    assert set(out["scored_labels"]) <= {">50K", "<=50K"}
+    acc = (np.asarray(out["scored_labels"]) ==
+           np.asarray(ds["income"])).mean()
+    assert acc > 0.8
+    probs = out["scored_probabilities"]
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_train_classifier_metadata_enables_zero_config_eval():
+    ds = _census_like(n=160)
+    model = TrainClassifier(label_col="income", epochs=15,
+                            learning_rate=5e-2).fit(ds)
+    scored = model.transform(ds)
+    stats = ComputeModelStatistics().transform(scored)
+    assert "accuracy" in stats.columns and "AUC" in stats.columns
+    assert 0.0 <= stats["AUC"][0] <= 1.0
+    per = ComputePerInstanceStatistics().transform(scored)
+    assert "log_loss" in per.columns and per["log_loss"].min() >= 0
+
+
+def test_train_classifier_explicit_labels():
+    ds = _census_like(n=80)
+    model = TrainClassifier(
+        label_col="income", labels=[">50K", "<=50K"], epochs=2
+    ).fit(ds)
+    assert model.levels == [">50K", "<=50K"]
+
+
+def test_train_regressor_end_to_end():
+    rng = np.random.default_rng(0)
+    n = 200
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    y = 3 * a - 2 * b + 1
+    ds = Dataset({"a": a, "b": b, "target": y})
+    model = TrainRegressor(label_col="target", epochs=80,
+                           learning_rate=0.05).fit(ds)
+    scored = model.transform(ds)
+    stats = ComputeModelStatistics().transform(scored)
+    assert stats["R^2"][0] > 0.9
+    per = ComputePerInstanceStatistics().transform(scored)
+    assert "L1_loss" in per.columns and "L2_loss" in per.columns
+
+
+def test_train_classifier_round_trip(tmp_path):
+    ds = _census_like(n=80)
+    model = TrainClassifier(label_col="income", epochs=3).fit(ds)
+    model.save(str(tmp_path / "tc"))
+    loaded = PipelineStage.load(str(tmp_path / "tc"))
+    a = model.transform(ds)
+    b = loaded.transform(ds)
+    assert list(a["scored_labels"]) == list(b["scored_labels"])
+
+
+# -- FindBestModel -----------------------------------------------------------
+
+
+def test_find_best_model():
+    ds = _census_like(n=160)
+    weak = TrainClassifier(label_col="income", epochs=1,
+                           learning_rate=1e-4).fit(ds)
+    strong = TrainClassifier(label_col="income", epochs=25,
+                             learning_rate=5e-2).fit(ds)
+    best = FindBestModel(models=[weak, strong]).fit(ds)
+    assert best.best_model is strong
+    assert best.all_model_metrics.num_rows == 2
+    out = best.transform(ds)
+    assert "scored_labels" in out.columns
+
+
+# -- evaluators (unit-level) -------------------------------------------------
+
+
+def test_binary_auc_perfect_and_random():
+    y = np.array([0, 0, 1, 1])
+    auc_perfect, roc = binary_auc(y, np.array([0.1, 0.2, 0.8, 0.9]))
+    assert auc_perfect > 0.99
+    assert roc.shape[1] == 2
+    auc_bad, _ = binary_auc(y, np.array([0.9, 0.8, 0.2, 0.1]))
+    assert auc_bad < 0.01
+
+
+def test_compute_stats_requires_metadata(basic_dataset):
+    with pytest.raises(Exception):
+        ComputeModelStatistics().transform(basic_dataset)
+
+
+# -- TextFeaturizer ----------------------------------------------------------
+
+
+def test_text_featurizer_idf():
+    ds = Dataset({"text": ["cat sat mat", "cat sat", "dog ran park"] * 3})
+    model = TextFeaturizer(input_col="text", output_col="tf",
+                           num_features=256).fit(ds)
+    out = model.transform(ds)
+    assert out["tf"].shape[0] == 9
+    # idf: 'cat' (6 docs) must weigh less than 'park' (3 docs)
+    assert out["tf"].shape[1] >= 5
+
+
+def test_text_featurizer_ngram_and_stopwords():
+    ds = Dataset({"text": ["the cat sat on the mat", "a dog in the park"]})
+    model = TextFeaturizer(
+        input_col="text", output_col="tf", remove_stop_words=True,
+        use_ngram=True, n_gram_length=2, use_idf=False, num_features=128,
+    ).fit(ds)
+    out = model.transform(ds)
+    # "cat sat", "sat mat", "dog park" bigrams after stopword removal
+    assert out["tf"].shape[1] == 3
+
+
+def test_text_featurizer_pretokenized():
+    ds = Dataset({"toks": [["a", "b"], ["b", "c"]]})
+    model = TextFeaturizer(input_col="toks", output_col="tf",
+                           use_idf=False).fit(ds)
+    assert model.transform(ds)["tf"].sum() == 4
+
+
+def test_auc_alignment_numeric_labels():
+    """Numeric labels whose repr-sort differs from value-sort (2.0 vs 10.0):
+    AUC must follow the model's level order, not repr order."""
+    rng = np.random.default_rng(3)
+    n = 200
+    x = rng.normal(size=n)
+    lab = np.where(x + rng.normal(0, 0.3, n) > 0, 10.0, 2.0)
+    ds = Dataset({"x": x, "label": lab})
+    model = TrainClassifier(label_col="label", epochs=25,
+                            learning_rate=5e-2).fit(ds)
+    scored = model.transform(ds)
+    stats = ComputeModelStatistics().transform(scored)
+    assert stats["AUC"][0] > 0.9  # would be ~1-AUC if misaligned
+
+
+def test_sequence_mse_loss_respects_kind():
+    """3-D logits with loss='mse' must NOT silently switch to softmax."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.train.trainer import masked_loss
+
+    logits = jnp.ones((2, 3, 1))
+    labels = jnp.ones((2, 3))
+    loss = masked_loss("mse", logits, labels, jnp.array([True, True]))
+    assert float(loss) == 0.0  # perfect predictions -> zero MSE

@@ -1,0 +1,127 @@
+"""SPMD trainer tests on the 8-device CPU mesh: loss decreases, gradient
+sync across shards is correct, checkpoint/resume works (reference analog:
+ValidateCntkTrain.scala e2e tiny-epoch training)."""
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.models import build_model
+from mmlspark_tpu.stages.dnn_learner import DNNLearner
+from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig, masked_loss
+
+
+def _two_blob_data(n=256, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    x = np.concatenate(
+        [rng.normal(-1.5, 1.0, (half, d)), rng.normal(1.5, 1.0, (half, d))]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(half), np.ones(half)]).astype(np.int32)
+    perm = rng.permutation(n)
+    return x[perm], y[perm]
+
+
+def test_loss_decreases_and_learns():
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(16,))
+    trainer = SPMDTrainer(
+        g, TrainConfig(epochs=5, batch_size=64, learning_rate=1e-2,
+                       log_every=1)
+    )
+    variables = trainer.train(x, y)
+    losses = [h["loss"] for h in trainer.history]
+    assert losses[-1] < losses[0] * 0.5
+    logits = np.asarray(g.apply(variables, x))
+    acc = float((np.argmax(logits, 1) == y).mean())
+    assert acc > 0.95
+
+
+def test_batch_sharded_over_mesh_matches_single_device():
+    """Gradient sync: training over the 8-way data axis must match the math
+    of unsharded training (same seed, same batches => same params)."""
+    x, y = _two_blob_data(n=128)
+    cfg = dict(epochs=2, batch_size=32, learning_rate=5e-3, shuffle=False,
+               log_every=1)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    v8 = SPMDTrainer(g, TrainConfig(**cfg)).train(x, y)
+    v1 = SPMDTrainer(
+        g, TrainConfig(**cfg, mesh_axes={"data": 1})
+    ).train(x, y)
+    for a, b in zip(jax.tree_util.tree_leaves(v8), jax.tree_util.tree_leaves(v1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-4)
+
+
+def test_mask_weighted_loss_ignores_padding():
+    import jax.numpy as jnp
+
+    logits = jnp.array([[2.0, 0.0], [0.0, 2.0], [9.0, -9.0]])
+    labels = jnp.array([0, 1, 1])  # third row wrong but masked out
+    full = masked_loss("softmax_xent", logits, labels,
+                       jnp.array([True, True, True]))
+    masked = masked_loss("softmax_xent", logits, labels,
+                         jnp.array([True, True, False]))
+    assert float(masked) < float(full)
+
+
+def test_checkpoint_resume(tmp_path):
+    x, y = _two_blob_data(n=64)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+
+    def cfg(epochs):
+        return TrainConfig(
+            epochs=epochs, batch_size=32, learning_rate=1e-2,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+            shuffle=False, log_every=1,
+        )
+
+    t1 = SPMDTrainer(g, cfg(epochs=1))
+    t1.train(x, y)
+    # resume run: picks up from the saved step, continues to epoch 2
+    t2 = SPMDTrainer(g, cfg(epochs=2))
+    t2.train(x, y)
+    assert t2.history[0]["step"] > 0  # did not restart from step 0
+
+
+def test_dnn_learner_stage_end_to_end():
+    x, y = _two_blob_data(n=128)
+    ds = Dataset({"features": x, "label": y})
+    learner = DNNLearner(
+        model_name="mlp",
+        model_config={"hidden": (16,)},
+        epochs=4,
+        batch_size=32,
+        learning_rate=1e-2,
+    )
+    model = learner.fit(ds)
+    out = model.transform(ds)
+    preds = np.argmax(out["scores"], axis=1)
+    assert (preds == y).mean() > 0.9
+    assert model.train_history  # history carried on the model
+
+
+def test_dnn_learner_drops_nan_labels():
+    x, y = _two_blob_data(n=64)
+    yf = y.astype(np.float64)
+    yf[:8] = np.nan
+    ds = Dataset({"features": x, "label": yf})
+    model = DNNLearner(model_name="mlp", epochs=1, batch_size=32).fit(ds)
+    assert model.weights is not None
+
+
+def test_regression_mse_loss():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ w
+    ds = Dataset({"features": x, "label": y})
+    model = DNNLearner(
+        model_name="linear", loss="mse", epochs=60, batch_size=64,
+        learning_rate=0.1, optimizer="momentum",
+    ).fit(ds)
+    out = model.transform(ds)
+    pred = out["scores"][:, 0]
+    resid = np.mean((pred - y) ** 2) / np.var(y)
+    assert resid < 0.05
