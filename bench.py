@@ -48,6 +48,15 @@ def main() -> None:
         final, _ = jax.lax.scan(body, x, None, length=iters)
         return final.mean()  # scalar: fetch cost is negligible
 
+    # Shard the batch over all devices (data axis) so the per-chip number
+    # stays honest on multi-device hosts; on one chip this is a no-op.
+    if jax.device_count() > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        x = jax.device_put(x, NamedSharding(mesh, P("data")))
+        variables = jax.device_put(variables, NamedSharding(mesh, P()))
+
     fwd = jax.jit(chained)
     np.asarray(fwd(variables, x))  # warmup / compile
 

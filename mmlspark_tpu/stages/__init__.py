@@ -16,6 +16,9 @@ _STAGE_MODULES = [
     "train_regressor",
     "eval_metrics",
     "find_best",
+    "image",
+    "prep",
+    "ensemble",
 ]
 
 import importlib

@@ -29,6 +29,7 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, Param, positive
+from mmlspark_tpu.core.schema import SCORES_COLUMN
 from mmlspark_tpu.core.stage import Model
 from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.data.feed import MASK_COL, batch_iterator, stack_column
@@ -55,6 +56,7 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
     )
 
     def __init__(self, **kwargs: Any):
+        kwargs.setdefault("output_col", SCORES_COLUMN)
         super().__init__(**kwargs)
         self._graph: NamedGraph | None = None
         self._jitted: dict = {}

@@ -20,10 +20,12 @@ AssembleFeatures.scala:76-459 — per-column dispatch by type:
 
 from __future__ import annotations
 
-import zlib
 from typing import Any
 
 import numpy as np
+
+from mmlspark_tpu.utils.text import hash_token as _hash_token
+from mmlspark_tpu.utils.text import tokenize as _shared_tokenize
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.params import Param, positive
@@ -43,13 +45,7 @@ _VECTOR = "vector"
 
 
 def _tokenize(value: str) -> list[str]:
-    import re
-
-    return [t for t in re.split(r"\W+", value.lower()) if t]
-
-
-def _hash_token(token: str, num_features: int) -> int:
-    return zlib.crc32(token.encode("utf-8")) % num_features
+    return _shared_tokenize(value)
 
 
 def _column_kind(dataset: Dataset, name: str) -> str:
@@ -164,14 +160,14 @@ class AssembleFeatures(Estimator):
             specs=specs,
             number_of_features=self.number_of_features,
         )
-        if self.standardize:
-            for spec in specs:
-                if spec["kind"] in (_NUMERIC, _DATETIME):
-                    block = model._block(dataset, spec)
-                    mean = np.nanmean(block, axis=0)
-                    std = np.nanstd(block, axis=0)
-                    spec["mean"] = mean
-                    spec["std"] = np.where(std > 0, std, 1.0)
+        for spec in specs:
+            block = model._block(dataset, spec)
+            spec["dim"] = int(block.shape[1])  # exact width for feature_dim
+            if self.standardize and spec["kind"] in (_NUMERIC, _DATETIME):
+                mean = np.nanmean(block, axis=0)
+                std = np.nanstd(block, axis=0)
+                spec["mean"] = mean
+                spec["std"] = np.where(std > 0, std, 1.0)
         return model
 
 
@@ -238,18 +234,8 @@ class AssembleFeaturesModel(Model):
 
     @property
     def feature_dim(self) -> int:
-        dim = 0
-        for s in self.specs:
-            k = s["kind"]
-            if k == _NUMERIC:
-                dim += 1
-            elif k == _CATEGORICAL:
-                dim += s["num_levels"] if s.get("one_hot", True) else 1
-            elif k == _TEXT:
-                dim += len(s["slots"])
-            elif k == _DATETIME:
-                dim += 7
-        return dim
+        """Exact assembled width (every kind's dim is recorded at fit)."""
+        return sum(int(s["dim"]) for s in self.specs)
 
 
 class Featurize(Estimator):

@@ -2,32 +2,26 @@
 
 Reference: text-featurizer/src/main/scala/TextFeaturizer.scala:180-405:
 RegexTokenizer -> StopWordsRemover -> NGram -> HashingTF -> IDF, each stage
-optional, tokenization auto-detected from the input type.
+optional, tokenization auto-detected from the input type. Tokenization +
+hashing live in :mod:`mmlspark_tpu.utils.text` (shared with Featurize so
+fit/transform paths can never diverge).
 """
 
 from __future__ import annotations
-
-import re
-import zlib
 
 import numpy as np
 
 from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, Param, positive
 from mmlspark_tpu.core.stage import Estimator, Model
 from mmlspark_tpu.data.dataset import Dataset
-
-#: compact english stopword list (Spark's StopWordsRemover default subset)
-STOP_WORDS = frozenset(
-    """a an and are as at be but by for if in into is it no not of on or such
-    that the their then there these they this to was will with""".split()
-)
+from mmlspark_tpu.utils.text import DEFAULT_PATTERN, hash_token, tokenize
 
 DEFAULT_NUM_FEATURES = 1 << 18
 
 
 class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     use_tokenizer = Param("split strings into tokens", True, ptype=bool)
-    tokenizer_pattern = Param("regex split pattern", r"\W+", ptype=str)
+    tokenizer_pattern = Param("regex split pattern", DEFAULT_PATTERN, ptype=str)
     to_lowercase = Param("lowercase before tokenizing", True, ptype=bool)
     remove_stop_words = Param("drop english stop words", False, ptype=bool)
     use_ngram = Param("emit n-grams instead of unigrams", False, ptype=bool)
@@ -40,32 +34,24 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     min_doc_freq = Param("min docs a slot must appear in for IDF", 1,
                          ptype=int)
 
-    def _tokens(self, value) -> list[str]:
-        if value is None:
-            return []
-        if isinstance(value, (list, tuple, np.ndarray)):
-            toks = [str(t) for t in value]  # pre-tokenized input
-        elif self.use_tokenizer:
-            v = value.lower() if self.to_lowercase else value
-            toks = [t for t in re.split(self.tokenizer_pattern, v) if t]
-        else:
-            toks = [value]
-        if self.remove_stop_words:
-            toks = [t for t in toks if t.lower() not in STOP_WORDS]
-        if self.use_ngram:
-            n = self.n_gram_length
-            toks = [
-                " ".join(toks[i : i + n]) for i in range(len(toks) - n + 1)
-            ]
-        return toks
+    def _tokenizer_config(self) -> dict:
+        return {
+            "use_tokenizer": self.use_tokenizer,
+            "tokenizer_pattern": self.tokenizer_pattern,
+            "to_lowercase": self.to_lowercase,
+            "remove_stop_words": self.remove_stop_words,
+            "use_ngram": self.use_ngram,
+            "n_gram_length": self.n_gram_length,
+        }
 
     def _fit(self, dataset: Dataset) -> "TextFeaturizerModel":
         dataset.require(self.input_col)
         nf = self.num_features
-        # term-frequency slots used + document frequency per slot
+        cfg = self._tokenizer_config()
+        # document frequency per used hash slot
         df_counts: dict[int, int] = {}
         for v in dataset[self.input_col]:
-            slots = {zlib.crc32(t.encode()) % nf for t in self._tokens(v)}
+            slots = {hash_token(t, nf) for t in tokenize(v, cfg)}
             for s in slots:
                 df_counts[s] = df_counts.get(s, 0) + 1
         slots = sorted(
@@ -84,14 +70,7 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
             slots=list(slots),
             idf=idf,
             num_features=nf,
-            tokenizer_config={
-                "use_tokenizer": self.use_tokenizer,
-                "tokenizer_pattern": self.tokenizer_pattern,
-                "to_lowercase": self.to_lowercase,
-                "remove_stop_words": self.remove_stop_words,
-                "use_ngram": self.use_ngram,
-                "n_gram_length": self.n_gram_length,
-            },
+            tokenizer_config=cfg,
         )
 
 
@@ -101,33 +80,16 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
     num_features = Param("hash space", DEFAULT_NUM_FEATURES, ptype=int)
     tokenizer_config = Param("tokenizer settings", default=dict)
 
-    def _tokens(self, value) -> list[str]:
-        cfg = self.tokenizer_config
-        if value is None:
-            return []
-        if isinstance(value, (list, tuple, np.ndarray)):
-            toks = [str(t) for t in value]
-        elif cfg.get("use_tokenizer", True):
-            v = value.lower() if cfg.get("to_lowercase", True) else value
-            toks = [t for t in re.split(cfg.get("tokenizer_pattern", r"\W+"), v) if t]
-        else:
-            toks = [value]
-        if cfg.get("remove_stop_words"):
-            toks = [t for t in toks if t.lower() not in STOP_WORDS]
-        if cfg.get("use_ngram"):
-            n = cfg.get("n_gram_length", 2)
-            toks = [" ".join(toks[i : i + n]) for i in range(len(toks) - n + 1)]
-        return toks
-
     def _transform(self, dataset: Dataset) -> Dataset:
         dataset.require(self.input_col)
         pos = {s: j for j, s in enumerate(self.slots)}
         nf = self.num_features
+        cfg = self.tokenizer_config
         idf = np.asarray(self.idf, dtype=np.float64)
         out = np.zeros((dataset.num_rows, len(self.slots)))
         for i, v in enumerate(dataset[self.input_col]):
-            for t in self._tokens(v):
-                j = pos.get(zlib.crc32(t.encode()) % nf)
+            for t in tokenize(v, cfg):
+                j = pos.get(hash_token(t, nf))
                 if j is not None:
                     out[i, j] += 1.0
         out *= idf

@@ -1,0 +1,62 @@
+"""Random dataset generation for verification.
+
+Reference: core/test/datagen/src/main/scala (``GenerateDataset`` builds random
+DataFrames from ``DatasetOptions`` — types x missings x dimensions — with
+seeds; used by VerifyTrainClassifier for benchmark-style verification).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from mmlspark_tpu.data.dataset import Dataset
+
+
+@dataclass(frozen=True)
+class DatasetOptions:
+    """What shapes/types to generate (GenerateDataset's options object)."""
+
+    num_rows: int = 32
+    num_numeric: int = 2
+    num_string: int = 1
+    num_bool: int = 1
+    num_vector: int = 0
+    vector_dim: int = 4
+    missing_ratio: float = 0.0  # NaN fraction in numeric columns
+    string_vocab: tuple = ("alpha", "beta", "gamma", "delta")
+    with_label: bool = True
+    label_kind: str = "binary"  # binary | multiclass | continuous
+    num_classes: int = 3
+    extra: dict = field(default_factory=dict)
+
+
+def generate_dataset(options: DatasetOptions = DatasetOptions(), seed: int = 0) -> Dataset:
+    rng = np.random.default_rng(seed)
+    n = options.num_rows
+    cols: dict = {}
+    for i in range(options.num_numeric):
+        vals = rng.normal(size=n)
+        if options.missing_ratio > 0:
+            mask = rng.random(n) < options.missing_ratio
+            vals = np.where(mask, np.nan, vals)
+        cols[f"num_{i}"] = vals
+    for i in range(options.num_string):
+        cols[f"str_{i}"] = list(rng.choice(options.string_vocab, n))
+    for i in range(options.num_bool):
+        cols[f"bool_{i}"] = rng.random(n) > 0.5
+    for i in range(options.num_vector):
+        cols[f"vec_{i}"] = rng.normal(size=(n, options.vector_dim))
+    if options.with_label:
+        if options.label_kind == "binary":
+            cols["label"] = list(
+                np.where(rng.random(n) > 0.5, "yes", "no")
+            )
+        elif options.label_kind == "multiclass":
+            cols["label"] = rng.integers(0, options.num_classes, n).astype(
+                np.int64
+            )
+        else:
+            cols["label"] = rng.normal(size=n)
+    return Dataset(cols)

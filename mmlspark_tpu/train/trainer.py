@@ -247,6 +247,10 @@ class SPMDTrainer:
 
         step = step0
         start_epoch = step0 // steps_per_epoch
+        # Mid-epoch resume: per-epoch shuffle is seed-deterministic, so
+        # skipping the first (step0 % steps_per_epoch) batches reproduces the
+        # exact data position the checkpoint was taken at.
+        skip_in_first = step0 % steps_per_epoch
         for epoch in range(start_epoch, cfg.epochs):
             ds = Dataset({"x": x, "y": y})
             it: Iterator = batch_iterator(
@@ -255,6 +259,10 @@ class SPMDTrainer:
                 batch,
                 shuffle_seed=(cfg.seed + epoch) if cfg.shuffle else None,
             )
+            if epoch == start_epoch and skip_in_first:
+                import itertools
+
+                it = itertools.islice(it, skip_in_first, None)
             for b in it:
                 bx = jax.device_put(jnp.asarray(b["x"]), data_sh)
                 by = jax.device_put(jnp.asarray(b["y"]), data_sh)

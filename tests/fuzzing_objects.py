@@ -1,0 +1,275 @@
+"""Per-stage test-object providers for the registry-wide fuzz tests.
+
+Mirror of the reference's ``FuzzObject`` providers (core/test/fuzzing/...
+Fuzzing.scala:15-27): every stage class contributes at least one
+(stage, dataset) pair; FuzzingTest then asserts framework-wide invariants
+over ALL registered stages with explicit exemption lists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.schema import ImageRow
+from mmlspark_tpu.core.stage import Pipeline, PipelineStage
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.models import build_model
+from mmlspark_tpu.testing.datagen import DatasetOptions, generate_dataset
+
+
+@dataclass
+class FuzzObject:
+    stage: PipelineStage
+    fit_ds: Dataset
+    #: dataset for transform after fit (defaults to fit_ds)
+    transform_ds: Dataset | None = None
+
+    @property
+    def score_ds(self) -> Dataset:
+        return self.transform_ds if self.transform_ds is not None else self.fit_ds
+
+
+def _mixed_ds(seed=0):
+    return generate_dataset(
+        DatasetOptions(num_rows=24, missing_ratio=0.0), seed=seed
+    )
+
+
+def _numeric_ds(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(32, 4))
+    y = (x[:, 0] > 0).astype(np.int32)
+    return Dataset({"features": x.astype(np.float32), "label": y})
+
+
+def _image_ds(n=3):
+    rng = np.random.default_rng(0)
+    rows = [
+        ImageRow(f"img{i}", rng.integers(0, 256, (8, 8, 3), dtype=np.uint8))
+        for i in range(n)
+    ]
+    return Dataset({"image": rows})
+
+
+def _tiny_tpu_model():
+    from mmlspark_tpu.stages.dnn_model import TPUModel
+
+    g = build_model("mlp", num_outputs=2, hidden=(4,))
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    return TPUModel.from_graph(
+        g, v, "mlp", model_config={"num_outputs": 2, "hidden": (4,)},
+        input_col="features", batch_size=8,
+    )
+
+
+def _tiny_resnet_model():
+    from mmlspark_tpu.stages.dnn_model import TPUModel
+
+    g = build_model("resnet20_cifar10", width=8)
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    return TPUModel.from_graph(
+        g, v, "resnet20_cifar10", model_config={"width": 8},
+        input_col="image", batch_size=8,
+    )
+
+
+def build_test_objects() -> dict[str, list[FuzzObject]]:
+    """stage class name -> test objects. Fitted-model classes are covered
+    through their estimator's fit (listed in DERIVED below)."""
+    from mmlspark_tpu.stages.dnn_learner import DNNLearner
+    from mmlspark_tpu.stages.ensemble import EnsembleByKey
+    from mmlspark_tpu.stages.eval_metrics import (
+        ComputeModelStatistics,
+        ComputePerInstanceStatistics,
+    )
+    from mmlspark_tpu.stages.featurize import AssembleFeatures, Featurize
+    from mmlspark_tpu.stages.find_best import FindBestModel
+    from mmlspark_tpu.stages.image import (
+        ImageFeaturizer,
+        ImageSetAugmenter,
+        ImageTransformer,
+        UnrollImage,
+    )
+    from mmlspark_tpu.stages.prep import (
+        Cacher,
+        CheckpointData,
+        ClassBalancer,
+        CleanMissingData,
+        DataConversion,
+        DropColumns,
+        MultiColumnAdapter,
+        PartitionSample,
+        Repartition,
+        SelectColumns,
+        SummarizeData,
+        Timer,
+    )
+    from mmlspark_tpu.stages.text import TextFeaturizer
+    from mmlspark_tpu.stages.train_classifier import TrainClassifier
+    from mmlspark_tpu.stages.train_regressor import TrainRegressor
+    from mmlspark_tpu.stages.value_indexer import IndexToValue, ValueIndexer
+
+    mixed = _mixed_ds()
+    numeric = _numeric_ds()
+    import tempfile
+
+    ckdir = tempfile.mkdtemp(prefix="fuzz_ck_")
+
+    classifier_ds = mixed
+    trained_classifier = TrainClassifier(label_col="label", epochs=1).fit(
+        classifier_ds
+    )
+    scored = trained_classifier.transform(classifier_ds)
+
+    objects: dict[str, list[FuzzObject]] = {
+        "Pipeline": [
+            FuzzObject(
+                Pipeline([SelectColumns(cols=["num_0", "label"])]), mixed
+            )
+        ],
+        "TPUModel": [FuzzObject(_tiny_tpu_model(), numeric)],
+        "DNNLearner": [
+            FuzzObject(
+                DNNLearner(model_name="mlp", model_config={"hidden": (4,)},
+                           epochs=1, batch_size=16),
+                numeric,
+            )
+        ],
+        "ValueIndexer": [
+            FuzzObject(ValueIndexer(input_col="str_0", output_col="i"), mixed)
+        ],
+        "IndexToValue": [
+            FuzzObject(
+                IndexToValue(input_col="i", output_col="orig"),
+                ValueIndexer(input_col="str_0", output_col="i")
+                .fit(mixed)
+                .transform(mixed),
+            )
+        ],
+        "AssembleFeatures": [
+            FuzzObject(
+                AssembleFeatures(
+                    columns_to_featurize=["num_0", "num_1", "str_0"],
+                    number_of_features=128,
+                ),
+                mixed,
+            )
+        ],
+        "Featurize": [
+            FuzzObject(
+                Featurize(
+                    feature_columns={"features": ["num_0", "str_0"]},
+                    number_of_features=128,
+                ),
+                mixed,
+            )
+        ],
+        "TextFeaturizer": [
+            FuzzObject(
+                TextFeaturizer(input_col="str_0", output_col="tf",
+                               num_features=64),
+                mixed,
+            )
+        ],
+        "TrainClassifier": [
+            FuzzObject(TrainClassifier(label_col="label", epochs=1), mixed)
+        ],
+        "TrainRegressor": [
+            FuzzObject(
+                TrainRegressor(label_col="num_0", epochs=1), mixed
+            )
+        ],
+        "ComputeModelStatistics": [FuzzObject(ComputeModelStatistics(), scored)],
+        "ComputePerInstanceStatistics": [
+            FuzzObject(ComputePerInstanceStatistics(), scored)
+        ],
+        "FindBestModel": [
+            FuzzObject(
+                FindBestModel(models=[trained_classifier]), classifier_ds
+            )
+        ],
+        "ImageTransformer": [
+            FuzzObject(ImageTransformer().resize(6, 6), _image_ds())
+        ],
+        "UnrollImage": [FuzzObject(UnrollImage(), _image_ds())],
+        "ImageFeaturizer": [
+            FuzzObject(
+                ImageFeaturizer(model=_tiny_resnet_model(),
+                                cut_output_layers=1),
+                _image_ds(),
+            )
+        ],
+        "ImageSetAugmenter": [FuzzObject(ImageSetAugmenter(), _image_ds())],
+        "Cacher": [FuzzObject(Cacher(), mixed)],
+        "CheckpointData": [
+            FuzzObject(
+                CheckpointData(checkpoint_dir=f"{ckdir}/cp",
+                               remove_checkpoint=False),
+                mixed,
+            )
+        ],
+        "DropColumns": [FuzzObject(DropColumns(cols=["bool_0"]), mixed)],
+        "SelectColumns": [FuzzObject(SelectColumns(cols=["num_0"]), mixed)],
+        "Repartition": [FuzzObject(Repartition(n=2), mixed)],
+        "ClassBalancer": [
+            FuzzObject(ClassBalancer(input_col="label"), mixed)
+        ],
+        "Timer": [
+            FuzzObject(Timer(stage=SelectColumns(cols=["num_0"])), mixed)
+        ],
+        "CleanMissingData": [
+            FuzzObject(
+                CleanMissingData(input_cols=["num_0"]),
+                generate_dataset(
+                    DatasetOptions(num_rows=16, missing_ratio=0.3), seed=3
+                ),
+            )
+        ],
+        "DataConversion": [
+            FuzzObject(
+                DataConversion(cols=["num_0"], convert_to="float"), mixed
+            )
+        ],
+        "PartitionSample": [
+            FuzzObject(PartitionSample(mode="Head", count=5), mixed)
+        ],
+        "SummarizeData": [FuzzObject(SummarizeData(), mixed)],
+        "MultiColumnAdapter": [
+            FuzzObject(
+                MultiColumnAdapter(
+                    base_stage=ValueIndexer(),
+                    input_cols=["str_0"],
+                    output_cols=["str_0_i"],
+                ),
+                mixed,
+            )
+        ],
+        "EnsembleByKey": [
+            FuzzObject(
+                EnsembleByKey(keys=["str_0"], cols=["num_0"]), mixed
+            )
+        ],
+    }
+    return objects
+
+
+#: fitted-model classes exercised via their estimator's fit in fuzzing
+DERIVED_MODEL_CLASSES = {
+    "PipelineModel": "Pipeline",
+    "ValueIndexerModel": "ValueIndexer",
+    "AssembleFeaturesModel": "AssembleFeatures",
+    "FeaturizeModel": "Featurize",
+    "TextFeaturizerModel": "TextFeaturizer",
+    "TrainedClassifierModel": "TrainClassifier",
+    "TrainedRegressorModel": "TrainRegressor",
+    "ClassBalancerModel": "ClassBalancer",
+    "CleanMissingDataModel": "CleanMissingData",
+    "BestModel": "FindBestModel",
+}
+
+#: stages that cannot be generically fuzzed, with the reason
+EXEMPTIONS: dict[str, str] = {}

@@ -1,0 +1,185 @@
+"""Image pipeline tests: ImageTransformer op semantics, UnrollImage,
+ImageFeaturizer headless features, ImageSetAugmenter (reference analog:
+ImageTransformerSuite, ImageFeaturizerSuite)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.schema import ImageRow
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.models import build_model
+from mmlspark_tpu.ops import image_ops
+from mmlspark_tpu.stages.dnn_model import TPUModel
+from mmlspark_tpu.stages.image import (
+    ImageFeaturizer,
+    ImageSetAugmenter,
+    ImageTransformer,
+    UnrollImage,
+)
+
+
+def _img(h=8, w=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+
+
+def _image_ds(n=3, h=8, w=6):
+    rows = [ImageRow(path=f"img{i}", data=_img(h, w, seed=i)) for i in range(n)]
+    return Dataset({"image": rows, "idx": np.arange(n)})
+
+
+# -- op semantics ------------------------------------------------------------
+
+
+def test_resize_shapes_and_identity():
+    img = _img(8, 6)
+    out = image_ops.resize(img, 16, 12)
+    assert out.shape == (16, 12, 3) and out.dtype == np.uint8
+    same = image_ops.resize(img, 8, 6)
+    np.testing.assert_array_equal(same, img)
+
+
+def test_crop_bounds():
+    img = _img(8, 6)
+    out = image_ops.crop(img, 1, 2, 4, 3)
+    np.testing.assert_array_equal(out, img[2:6, 1:4])
+    with pytest.raises(FriendlyError):
+        image_ops.crop(img, 4, 4, 10, 10)
+
+
+def test_gray_uses_bgr_weights():
+    img = np.zeros((2, 2, 3), np.uint8)
+    img[..., 2] = 100  # pure red in BGR
+    gray = image_ops.color_format(img, "gray")
+    assert gray.shape == (2, 2, 1)
+    assert abs(int(gray[0, 0, 0]) - 30) <= 1  # 0.299 * 100
+
+
+def test_blur_constant_invariant():
+    img = np.full((6, 6, 3), 77, np.uint8)
+    np.testing.assert_array_equal(image_ops.blur(img, 3, 3), img)
+    out = image_ops.gaussian_kernel(img.astype(np.uint8), 5, 1.2)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_threshold_kinds():
+    img = np.array([[[10, 100, 200]]], np.uint8)
+    assert list(image_ops.threshold(img, 99, 255, "binary")[0, 0]) == [0, 255, 255]
+    assert list(image_ops.threshold(img, 99, 255, "trunc")[0, 0]) == [10, 99, 99]
+    assert list(image_ops.threshold(img, 99, 255, "tozero")[0, 0]) == [0, 100, 200]
+
+
+def test_flip_codes():
+    img = _img(4, 4)
+    np.testing.assert_array_equal(image_ops.flip(img, 1), img[:, ::-1])
+    np.testing.assert_array_equal(image_ops.flip(img, 0), img[::-1])
+    np.testing.assert_array_equal(image_ops.flip(img, -1), img[::-1, ::-1])
+
+
+# -- ImageTransformer stage --------------------------------------------------
+
+
+def test_transformer_pipeline_and_round_trip(tmp_path):
+    ds = _image_ds()
+    t = ImageTransformer().resize(12, 10).crop(1, 1, 8, 8).flip(1)
+    out = t.transform(ds)
+    assert all(r.data.shape == (8, 8, 3) for r in out["image"])
+    t.save(str(tmp_path / "it"))
+    loaded = PipelineStage.load(str(tmp_path / "it"))
+    out2 = loaded.transform(ds)
+    np.testing.assert_array_equal(out["image"][0].data, out2["image"][0].data)
+
+
+def test_transformer_accepts_binary_and_drops_bad():
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(_img(5, 5)[:, :, ::-1]).save(buf, "PNG")
+    ds = Dataset({"image": [buf.getvalue(), b"garbage bytes here"],
+                  "tag": ["good", "bad"]})
+    out = ImageTransformer().resize(4, 4).transform(ds)
+    assert out.num_rows == 1 and out["tag"][0] == "good"
+
+
+def test_unknown_op_rejected():
+    ds = _image_ds(1)
+    t = ImageTransformer()
+    t.stages = [{"op": "sharpen"}]
+    with pytest.raises(FriendlyError):
+        t.transform(ds)
+
+
+# -- UnrollImage -------------------------------------------------------------
+
+
+def test_unroll_chw_layout():
+    img = _img(2, 3)
+    ds = Dataset({"image": [ImageRow("p", img)]})
+    out = UnrollImage().transform(ds)
+    vec = out["unrolled"][0]
+    assert vec.shape == (2 * 3 * 3,)
+    # CHW: first H*W entries are channel 0 (B plane), row-major
+    np.testing.assert_array_equal(
+        vec[: 2 * 3], img[:, :, 0].reshape(-1).astype(np.float64)
+    )
+
+
+def test_unroll_requires_uniform_sizes():
+    ds = Dataset({"image": [ImageRow("a", _img(2, 2)), ImageRow("b", _img(3, 3))]})
+    with pytest.raises(FriendlyError):
+        UnrollImage().transform(ds)
+
+
+# -- ImageFeaturizer ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def resnet_stage():
+    g = build_model("resnet20_cifar10", width=8)
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    return TPUModel.from_graph(
+        g, v, "resnet20_cifar10", model_config={"width": 8},
+        input_col="image", output_col="scores",
+    )
+
+
+def test_featurizer_headless_features(resnet_stage):
+    ds = _image_ds(n=4, h=20, w=30)  # wrong size on purpose -> auto-resize
+    feats = ImageFeaturizer(model=resnet_stage, cut_output_layers=1).transform(ds)
+    assert feats["features"].shape == (4, 32)  # pool features, width 8 * 4
+    assert list(feats["idx"]) == [0, 1, 2, 3]
+    scores = ImageFeaturizer(model=resnet_stage, cut_output_layers=0).transform(ds)
+    assert scores["features"].shape == (4, 10)
+
+
+def test_featurizer_cut_out_of_range(resnet_stage):
+    with pytest.raises(FriendlyError):
+        ImageFeaturizer(model=resnet_stage, cut_output_layers=99).transform(
+            _image_ds(1)
+        )
+
+
+# -- ImageSetAugmenter -------------------------------------------------------
+
+
+def test_augmenter_unions_flips():
+    ds = _image_ds(n=2)
+    out = ImageSetAugmenter(flip_left_right=True, flip_up_down=True).transform(ds)
+    assert out.num_rows == 6
+    orig = ds["image"][0].data
+    lr = out["image"][2].data
+    np.testing.assert_array_equal(lr, orig[:, ::-1])
+
+
+def test_typoed_op_param_surfaces_error():
+    ds = _image_ds(1)
+    t = ImageTransformer()
+    t.stages = [{"op": "crop", "x": 0, "hight": 5, "width": 5}]  # typo
+    with pytest.raises(FriendlyError):
+        t.transform(ds)

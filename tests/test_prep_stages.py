@@ -1,0 +1,179 @@
+"""Data-prep stage tests (reference analog: per-module Verify* suites for
+pipeline-stages, clean-missing-data, data-conversion, partition-sample,
+summarize-data, multi-column-adapter, ensemble)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.stages.ensemble import EnsembleByKey
+from mmlspark_tpu.stages.prep import (
+    Cacher,
+    CheckpointData,
+    ClassBalancer,
+    CleanMissingData,
+    DataConversion,
+    DropColumns,
+    MultiColumnAdapter,
+    PartitionSample,
+    Repartition,
+    SelectColumns,
+    SummarizeData,
+    Timer,
+)
+from mmlspark_tpu.stages.value_indexer import ValueIndexer
+
+
+def test_select_drop_repartition(basic_dataset):
+    sel = SelectColumns(cols=["numbers", "words"]).transform(basic_dataset)
+    assert sel.columns == ["numbers", "words"]
+    drp = DropColumns(cols=["flags"]).transform(basic_dataset)
+    assert "flags" not in drp
+    rep = Repartition(n=4).transform(basic_dataset)
+    assert rep.num_partitions == 4
+    assert Cacher().transform(basic_dataset) is basic_dataset
+
+
+def test_drop_missing_column_rejected(basic_dataset):
+    with pytest.raises(Exception):
+        DropColumns(cols=["nope"]).transform(basic_dataset)
+
+
+def test_checkpoint_data(tmp_path, basic_dataset):
+    out = CheckpointData(
+        checkpoint_dir=str(tmp_path / "ck"), remove_checkpoint=True
+    ).transform(basic_dataset)
+    assert out.num_rows == basic_dataset.num_rows
+    assert not (tmp_path / "ck").exists()
+
+
+def test_class_balancer():
+    ds = Dataset({"label": ["a"] * 6 + ["b"] * 2})
+    model = ClassBalancer(input_col="label").fit(ds)
+    out = model.transform(ds)
+    w = out["weight"]
+    assert w[0] == 1.0 and w[-1] == 3.0  # 6/6 and 6/2
+
+
+def test_timer_wraps_and_records(basic_dataset):
+    timer = Timer(stage=SelectColumns(cols=["numbers"]))
+    out = timer.transform(basic_dataset)
+    assert out.columns == ["numbers"]
+    assert timer.records and timer.records[0]["seconds"] >= 0
+    est_timer = Timer(stage=ValueIndexer(input_col="words", output_col="i"))
+    out2 = est_timer.transform(basic_dataset)
+    assert "i" in out2.columns
+    assert [r["op"] for r in est_timer.records] == ["fit", "transform"]
+
+
+def test_clean_missing_data_modes():
+    ds = Dataset({"x": np.array([1.0, np.nan, 3.0]),
+                  "y": np.array([np.nan, 10.0, 20.0])})
+    mean_model = CleanMissingData(input_cols=["x", "y"]).fit(ds)
+    out = mean_model.transform(ds)
+    assert out["x"][1] == 2.0 and out["y"][0] == 15.0
+    med = CleanMissingData(input_cols=["x"], cleaning_mode="Median").fit(ds)
+    assert med.transform(ds)["x"][1] == 2.0
+    cust = CleanMissingData(
+        input_cols=["x"], cleaning_mode="Custom", custom_value=-1.0
+    ).fit(ds)
+    assert cust.transform(ds)["x"][1] == -1.0
+    with pytest.raises(FriendlyError):
+        CleanMissingData(input_cols=["x"], cleaning_mode="Custom").fit(ds)
+
+
+def test_data_conversion_casts(basic_dataset):
+    out = DataConversion(cols=["numbers"], convert_to="double").transform(
+        basic_dataset
+    )
+    assert out["numbers"].dtype == np.float64
+    s = DataConversion(cols=["numbers"], convert_to="string").transform(
+        basic_dataset
+    )
+    assert list(s["numbers"]) == ["0", "1", "2", "3"]
+
+
+def test_data_conversion_date_round_trip():
+    ds = Dataset({"when": ["2017-06-04 10:30:00", "2018-01-01 00:00:00"]})
+    as_date = DataConversion(cols=["when"], convert_to="date").transform(ds)
+    assert as_date["when"].dtype.kind == "M"
+    back = DataConversion(cols=["when"], convert_to="string").transform(as_date)
+    assert list(back["when"]) == ["2017-06-04 10:30:00", "2018-01-01 00:00:00"]
+
+
+def test_data_conversion_categorical_round_trip():
+    ds = Dataset({"c": ["x", "y", "x"]})
+    cat = DataConversion(cols=["c"], convert_to="toCategorical").transform(ds)
+    assert cat.meta_of("c").categorical is not None
+    cleared = DataConversion(cols=["c"], convert_to="clearCategorical").transform(cat)
+    assert cleared.meta_of("c").categorical is None
+    assert list(cleared["c"]) == ["x", "y", "x"]
+
+
+def test_partition_sample_modes():
+    ds = Dataset({"x": np.arange(100)})
+    head = PartitionSample(mode="Head", count=7).transform(ds)
+    assert head.num_rows == 7 and list(head["x"]) == list(range(7))
+    pct = PartitionSample(mode="RandomSample", percent=0.2, seed=1).transform(ds)
+    assert pct.num_rows == 20
+    absolute = PartitionSample(
+        mode="RandomSample", random_sample_mode="Absolute", count=15, seed=1
+    ).transform(ds)
+    assert absolute.num_rows == 15
+    assigned = PartitionSample(mode="AssignToPartition", num_parts=4).transform(ds)
+    assert set(assigned["Partition"]) == {0, 1, 2, 3}
+    assert assigned.num_partitions == 4
+
+
+def test_summarize_data(basic_dataset):
+    stats = SummarizeData().transform(basic_dataset)
+    assert stats.num_rows == len(basic_dataset.columns)
+    row = {c: stats[c][0] for c in stats.columns}  # 'numbers' row
+    assert row["Feature"] == "numbers"
+    assert row["Count"] == 4 and row["Min"] == 0 and row["Max"] == 3
+    assert "P50" in stats.columns
+    counts_only = SummarizeData(basic=False, sample=False,
+                                percentiles=False).transform(basic_dataset)
+    assert "Min" not in counts_only.columns
+
+
+def test_multi_column_adapter(basic_dataset):
+    adapter = MultiColumnAdapter(
+        base_stage=ValueIndexer(),
+        input_cols=["words", "flags"],
+        output_cols=["words_i", "flags_i"],
+    )
+    out = adapter.transform(basic_dataset)
+    assert "words_i" in out.columns and "flags_i" in out.columns
+    with pytest.raises(FriendlyError):
+        MultiColumnAdapter(
+            base_stage=ValueIndexer(), input_cols=["a"], output_cols=[]
+        ).transform(basic_dataset)
+
+
+def test_ensemble_by_key_collapse_and_broadcast():
+    ds = Dataset({
+        "key": ["a", "a", "b"],
+        "score": np.array([1.0, 3.0, 10.0]),
+        "vec": np.array([[1.0, 0.0], [3.0, 2.0], [5.0, 5.0]]),
+    })
+    collapsed = EnsembleByKey(keys=["key"], cols=["score", "vec"]).transform(ds)
+    assert collapsed.num_rows == 2
+    got = dict(zip(collapsed["key"], collapsed["score_avg"]))
+    assert got == {"a": 2.0, "b": 10.0}
+    vecs = dict(zip(collapsed["key"], collapsed["vec_avg"]))
+    np.testing.assert_array_equal(vecs["a"], [2.0, 1.0])
+    broadcast = EnsembleByKey(
+        keys=["key"], cols=["score"], collapse_group=False
+    ).transform(ds)
+    assert broadcast.num_rows == 3
+    assert list(broadcast["score_avg"]) == [2.0, 2.0, 10.0]
+
+
+def test_clean_missing_zero_config_skips_non_numeric():
+    ds = Dataset({"s": ["a", None, "b"], "n": np.array([1.0, np.nan, 3.0])})
+    out = CleanMissingData().fit(ds).transform(ds)
+    assert out["n"][1] == 2.0 and list(out["s"]) == ["a", None, "b"]
+    with pytest.raises(FriendlyError):
+        CleanMissingData(input_cols=["s"]).fit(ds)

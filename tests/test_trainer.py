@@ -125,3 +125,28 @@ def test_regression_mse_loss():
     pred = out["scores"][:, 0]
     resid = np.mean((pred - y) ** 2) / np.var(y)
     assert resid < 0.05
+
+
+def test_mid_epoch_resume_continues_data_position(tmp_path):
+    """Kill mid-epoch; resume must continue at the next batch, not replay
+    the epoch (step arithmetic drives the LR schedule and history)."""
+    x, y = _two_blob_data(n=96)  # 3 steps/epoch at batch 32
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+
+    def cfg(epochs):
+        return TrainConfig(epochs=epochs, batch_size=32, learning_rate=1e-2,
+                           checkpoint_dir=str(tmp_path / "ck"),
+                           checkpoint_every=1, shuffle=False, log_every=1)
+
+    # full 2-epoch run for ground truth step count
+    t_full = SPMDTrainer(g, cfg(2))
+    t_full.train(x, y)
+    total_steps_full = t_full.history[-1]["step"]
+    # now simulate crash after 1 epoch + resume to 2 epochs
+    import shutil
+    shutil.rmtree(tmp_path / "ck")
+    SPMDTrainer(g, cfg(1)).train(x, y)
+    t_resumed = SPMDTrainer(g, cfg(2))
+    t_resumed.train(x, y)
+    assert t_resumed.history[-1]["step"] == total_steps_full
+    assert t_resumed.history[0]["step"] == 3  # continued, no replay
