@@ -1,0 +1,165 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op the reference never had (no attention code exists in the
+reference tree — SURVEY.md §5): blockwise streaming-softmax attention that
+keeps the running (max, normalizer, accumulator) in VMEM scratch across the
+K-block grid dimension, so the (S, S) score matrix never hits HBM. Q/K/V
+tiles stream HBM→VMEM via the grid BlockSpecs; scores and the P·V matmul
+run on the MXU in float32 accumulation.
+
+Backward pass: ``jax.custom_vjp`` recomputes through the XLA dense path
+(:func:`mmlspark_tpu.ops.attention.dense_attention`) — flash-style memory
+savings where they matter most (long-sequence forward / inference), exact
+gradients everywhere.
+
+Off-TPU (the unit-test CPU mesh) the kernel runs in interpreter mode, so
+the same code path is tested everywhere.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mmlspark_tpu.ops.attention import dense_attention
+
+NEG_INF = -1e30  # finite: -inf minus -inf would poison the running max
+LANES = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            scale: float, causal: bool, blk: int, seq_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: K blocks fully above the diagonal contribute nothing
+    live = (ki * blk <= qi * blk + blk - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (blk, blk)
+        kpos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        pad_mask = kpos >= seq_len  # padded keys never attend
+        if causal:
+            qpos = qi * blk + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            pad_mask = pad_mask | (kpos > qpos)
+        s = jnp.where(pad_mask, NEG_INF, s)
+
+        m_prev = m_scr[:, :1]  # (blk, 1), lanes replicated
+        m_cur = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+
+
+def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
+                   interpret: bool):
+    b, s, h, d = q.shape
+    blk = min(block, _round_up(s, 8))
+    s_pad = _round_up(s, blk)
+
+    def to_bh(t):
+        t = jnp.moveaxis(t, 2, 1).reshape(b * h, s, d)
+        if s_pad != s:
+            t = jnp.pad(t, ((0, 0), (0, s_pad - s), (0, 0)))
+        return t
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    n_blk = s_pad // blk
+    grid = (b * h, n_blk, n_blk)
+    tile = lambda im: pl.BlockSpec((1, blk, d), im,
+                                   memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        partial(_kernel, scale=scale, causal=causal, blk=blk,
+                seq_len=s),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            tile(lambda bh, i, j: (bh, i, 0)),  # Q: row block
+            tile(lambda bh, i, j: (bh, j, 0)),  # K: column block
+            tile(lambda bh, i, j: (bh, j, 0)),  # V: column block
+        ],
+        out_specs=tile(lambda bh, i, j: (bh, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((blk, LANES), jnp.float32),  # running max
+            pltpu.VMEM((blk, LANES), jnp.float32),  # running normalizer
+            pltpu.VMEM((blk, d), jnp.float32),      # accumulator
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    out = out[:, :s].reshape(b, h, s, d)
+    return jnp.moveaxis(out, 1, 2)
+
+
+@lru_cache(maxsize=None)
+def _build(causal: bool, scale_key, block: int, interpret: bool):
+    @jax.custom_vjp
+    def f(q, k, v):
+        scale = scale_key if scale_key else q.shape[-1] ** -0.5
+        return _flash_forward(q, k, v, causal=causal, scale=scale,
+                              block=block, interpret=interpret)
+
+    def fwd(q, k, v):
+        return f(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        scale = scale_key if scale_key else q.shape[-1] ** -0.5
+        _, vjp = jax.vjp(
+            lambda q, k, v: dense_attention(q, k, v, causal=causal,
+                                            scale=scale),
+            q, k, v,
+        )
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None,
+                    block: int = 128, interpret: bool | None = None):
+    """Blockwise fused attention, (B, S, H, D) layout, exact output.
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, interpreter
+    elsewhere (tests). Sequences are padded to the block size internally;
+    padded keys are masked, padded query rows are sliced away.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _build(causal, scale, block, bool(interpret))(q, k, v)

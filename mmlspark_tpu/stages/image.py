@@ -204,6 +204,27 @@ class UnrollImage(Transformer, HasInputCol, HasOutputCol):
         )
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _resize_scale_fn(h: int, w: int, scale: float):
+    """Jitted NHWC batch resize + uint8-rounding + scale, cached per
+    target shape so repeated transforms reuse the compiled program."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.ops.image_ops import batch_resize_nhwc
+
+    @jax.jit
+    def f(batch_f32):
+        x = batch_resize_nhwc(batch_f32, h, w)
+        # round through the uint8 grid to match the host path exactly
+        return jnp.clip(jnp.round(x), 0, 255) * scale
+
+    return f
+
+
 class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     """Transfer-learning featurizer: resize -> normalize -> headless net.
 
@@ -244,14 +265,37 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             )
         h, w = graph.input_shape[0], graph.input_shape[1]
 
-        # host: decode/resize to the model's input (per-row sizes may vary)
-        resized = ImageTransformer(
-            input_col=self.input_col, output_col="__resized__"
-        ).resize(h, w).transform(dataset)
-        batchable = np.stack(
-            [r.data.astype(np.float32) * self.scale
-             for r in resized["__resized__"]]
-        ) if resized.num_rows else np.zeros((0, h, w, 3), np.float32)
+        from mmlspark_tpu.core.schema import ImageRow
+
+        rows = dataset[self.input_col]
+        imgs = [
+            r.data if isinstance(r, ImageRow) else np.asarray(r)
+            for r in rows
+        ]
+        uniform = bool(imgs) and all(
+            im.shape == imgs[0].shape for im in imgs
+        )
+        if uniform:
+            # hot path: equally-sized images resize + normalize as ONE
+            # jitted NHWC batch op per chunk on device (XLA fuses the
+            # scale into the resize) instead of a per-row host loop
+            fn = _resize_scale_fn(h, w, float(self.scale))
+            chunks = []
+            step = max(self.batch_size, 1)
+            for i in range(0, len(imgs), step):
+                block = np.stack(imgs[i:i + step]).astype(np.float32)
+                chunks.append(np.asarray(fn(block)))
+            batchable = np.concatenate(chunks, axis=0)
+            base = dataset
+        else:
+            # ragged sizes: per-row host resize (exact OpenCV semantics)
+            base = ImageTransformer(
+                input_col=self.input_col, output_col="__resized__"
+            ).resize(h, w).transform(dataset)
+            batchable = np.stack(
+                [r.data.astype(np.float32) * self.scale
+                 for r in base["__resized__"]]
+            ) if base.num_rows else np.zeros((0, h, w, 3), np.float32)
 
         scorer = model.copy(
             input_col="__nhwc__",
@@ -260,7 +304,7 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             batch_size=self.batch_size,
         )
         scorer.set(weights=model.weights)
-        with_batch = resized.with_column("__nhwc__", batchable)
+        with_batch = base.with_column("__nhwc__", batchable)
         out = scorer.transform(with_batch)
         return out.drop("__resized__", "__nhwc__")
 

@@ -58,6 +58,10 @@ class TrainConfig:
     shuffle: bool = True
     # mesh: axis name -> size; None = all devices on the data axis
     mesh_axes: dict | None = None
+    # tensor-parallel param sharding rules: ordered (regex, spec_tuple)
+    # pairs (see parallel/sharding.py, e.g. TRANSFORMER_TP_RULES); None =
+    # fully replicated params (the reference's only strategy)
+    param_rules: Any = None
     # step-level checkpointing (orbax)
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end
@@ -231,16 +235,49 @@ class SPMDTrainer:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_rest, new_opt, loss
 
-        jitted = jax.jit(
-            step_fn,
-            in_shardings=(rep_sh, rep_sh, rep_sh, data_sh, data_sh, data_sh),
-            out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh),
-            donate_argnums=(0, 1, 2),
-        )
+        if cfg.param_rules:
+            # tensor parallelism: shard params per rule set; optimizer
+            # state inherits each param's sharding (GSPMD propagates
+            # through tx.init), and the train step is compiled without
+            # explicit shardings — committed inputs drive GSPMD, which
+            # inserts the ICI collectives.
+            from mmlspark_tpu.parallel.sharding import build_param_shardings
 
-        params = jax.device_put(params, rep_sh)
-        rest = jax.device_put(rest, rep_sh)
-        opt_state = jax.device_put(opt_state, rep_sh)
+            param_sh = build_param_shardings(params, mesh, cfg.param_rules)
+            params = jax.device_put(params, param_sh)
+            opt_template = jax.jit(tx.init)(params)
+            mesh_devs = set(mesh.devices.flat)
+
+            def _opt_sharding(leaf):
+                # leaves tx.init derived from params keep the param
+                # sharding; fresh scalars (step counts) land on one device
+                # and must be re-replicated over the mesh
+                if set(leaf.sharding.device_set) == mesh_devs:
+                    return leaf.sharding
+                return rep_sh
+
+            opt_state = jax.tree_util.tree_map(
+                lambda t, v: jax.device_put(
+                    jnp.asarray(v), _opt_sharding(t)
+                ),
+                opt_template,
+                opt_state,
+            )
+            rest = jax.device_put(rest, rep_sh)
+            jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        else:
+            jitted = jax.jit(
+                step_fn,
+                in_shardings=(
+                    rep_sh, rep_sh, rep_sh, data_sh, data_sh, data_sh,
+                ),
+                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh),
+                donate_argnums=(0, 1, 2),
+            )
+
+            params = jax.device_put(params, rep_sh)
+            rest = jax.device_put(rest, rep_sh)
+            opt_state = jax.device_put(opt_state, rep_sh)
 
         from mmlspark_tpu.data.feed import MASK_COL, batch_iterator
         from mmlspark_tpu.data.dataset import Dataset
@@ -276,7 +313,14 @@ class SPMDTrainer:
                         {"step": step, "epoch": epoch, "loss": loss_val}
                     )
                     _log.info("step %d epoch %d loss %.5f", step, epoch, loss_val)
-                if mngr is not None and cfg.checkpoint_every:
+                if (
+                    mngr is not None
+                    and cfg.checkpoint_every
+                    and mngr.should_save(step)
+                ):
+                    # gate BEFORE building args: _ckpt_args device_gets the
+                    # whole (possibly TP-sharded) state, which would stall
+                    # async dispatch on every non-checkpoint step
                     mngr.save(
                         step,
                         args=_ckpt_args(params, rest, opt_state),
