@@ -48,24 +48,7 @@ class NamedGraph:
         return [n for n, _ in self.blocks]
 
     def _check_node(self, node: str | int | None) -> str | None:
-        if node is None:
-            return None
-        if isinstance(node, int):
-            # output node by index (CNTKModel.setOutputNode index variant,
-            # CNTKModel.scala:166-170)
-            try:
-                return self.blocks[node][0]
-            except IndexError:
-                raise FriendlyError(
-                    f"output node index {node} out of range for "
-                    f"{len(self.blocks)} blocks"
-                )
-        if node not in self.layer_names:
-            raise FriendlyError(
-                f"no node '{node}' in graph '{self.name}'; "
-                f"nodes: {self.layer_names}"
-            )
-        return node
+        return resolve_node(self.layer_names, node, self.name)
 
     def init(self, rng, sample):
         """Initialize per-block variables by threading a sample through."""
@@ -74,6 +57,8 @@ class NamedGraph:
         for block_name, mod in self.blocks:
             rng, sub = jax.random.split(rng)
             v = mod.init({"params": sub}, x)
+            # sown auxiliary losses are per-call values, not state
+            v = {k: c for k, c in v.items() if k != "losses"}
             variables[block_name] = v
             x = mod.apply(v, x)
         return variables
@@ -85,11 +70,14 @@ class NamedGraph:
         output_node: str | int | None = None,
         train: bool = False,
         rngs: dict | None = None,
+        mask=None,
     ):
         """Forward pass; stops at ``output_node`` when given (headless net).
 
         In train mode returns ``(out, updated_variables)`` where updated
         variables carry new batch statistics; in eval mode returns ``out``.
+        ``mask`` (optional, (B,) 0/1 real-row mask) is forwarded to blocks
+        whose ``__call__`` accepts it (e.g. MoE routing excludes padding).
         """
         stop = self._check_node(output_node)
         updated = dict(variables)
@@ -98,17 +86,22 @@ class NamedGraph:
             kwargs: dict[str, Any] = {}
             if _accepts_train(mod):
                 kwargs["train"] = train
+            if mask is not None and _accepts_kwarg(mod, "mask"):
+                kwargs["mask"] = mask
             if train:
                 has_stats = "batch_stats" in v
+                # strip stale sown losses so each call sows fresh values
+                v_in = {k: c for k, c in v.items() if k != "losses"}
+                mutable = (["batch_stats"] if has_stats else []) + ["losses"]
                 x, mutated = mod.apply(
-                    v,
+                    v_in,
                     x,
-                    mutable=["batch_stats"] if has_stats else [],
+                    mutable=mutable,
                     rngs=rngs,
                     **kwargs,
                 )
-                if has_stats:
-                    updated[block_name] = {**v, **mutated}
+                if mutated:
+                    updated[block_name] = {**v_in, **mutated}
             else:
                 x = mod.apply(v, x, **kwargs)
             if block_name == stop:
@@ -128,15 +121,45 @@ class NamedGraph:
         )
 
     def param_count(self, variables) -> int:
-        return sum(
-            leaf.size for leaf in jax.tree_util.tree_leaves(variables)
+        return count_params(variables)
+
+
+def resolve_node(layer_names: Sequence[str], node: str | int | None,
+                 graph_name: str) -> str | None:
+    """Resolve an output-node selector (name or index, the CNTKModel
+    setOutputNode variants, CNTKModel.scala:166-170) against ordered node
+    names; raises FriendlyError for unknown selectors."""
+    if node is None:
+        return None
+    if isinstance(node, int):
+        try:
+            return layer_names[node]
+        except IndexError:
+            raise FriendlyError(
+                f"output node index {node} out of range for "
+                f"{len(layer_names)} nodes"
+            )
+    if node not in layer_names:
+        raise FriendlyError(
+            f"no node '{node}' in graph '{graph_name}'; "
+            f"nodes: {list(layer_names)}"
         )
+    return node
 
 
-def _accepts_train(mod) -> bool:
+def count_params(variables) -> int:
+    """Total leaf element count of a variables pytree."""
+    return sum(leaf.size for leaf in jax.tree_util.tree_leaves(variables))
+
+
+def _accepts_kwarg(mod, name: str) -> bool:
     import inspect
 
     try:
-        return "train" in inspect.signature(type(mod).__call__).parameters
+        return name in inspect.signature(type(mod).__call__).parameters
     except (ValueError, TypeError):  # pragma: no cover
         return False
+
+
+def _accepts_train(mod) -> bool:
+    return _accepts_kwarg(mod, "train")

@@ -323,29 +323,18 @@ class OnnxGraph:
         return [(n.name, n) for n in self.nodes]
 
     def _check_node(self, node: str | int | None) -> str | None:
-        if node is None:
-            return None
-        if isinstance(node, int):
-            try:
-                return self.nodes[node].name
-            except IndexError:
-                raise FriendlyError(
-                    f"output node index {node} out of range for "
-                    f"{len(self.nodes)} nodes"
-                )
-        if node not in self.layer_names:
-            raise FriendlyError(
-                f"no node '{node}' in graph '{self.name}'; "
-                f"nodes: {self.layer_names}"
-            )
-        return node
+        from mmlspark_tpu.models.graph import resolve_node
+
+        return resolve_node(self.layer_names, node, self.name)
 
     def init(self, rng=None, sample=None) -> dict:
         """Imported graphs arrive trained; variables are the initializers."""
         return {"onnx": {"params": dict(self.initializers)}}
 
     def apply(self, variables, x, output_node: str | int | None = None,
-              train: bool = False, rngs=None):
+              train: bool = False, rngs=None, mask=None):
+        # mask accepted for trainer-interface uniformity; imported graphs
+        # have no routing/stats that depend on padding rows
         import jax.numpy as jnp
 
         params = variables["onnx"]["params"]

@@ -42,6 +42,8 @@ def _ensure_loaded() -> None:
     # builder modules self-register on import
     import mmlspark_tpu.models.bilstm  # noqa: F401
     import mmlspark_tpu.models.mlp  # noqa: F401
+    import mmlspark_tpu.models.moe  # noqa: F401
     import mmlspark_tpu.models.onnx_import  # noqa: F401
+    import mmlspark_tpu.models.pipelined  # noqa: F401
     import mmlspark_tpu.models.resnet  # noqa: F401
     import mmlspark_tpu.models.transformer  # noqa: F401

@@ -11,12 +11,22 @@ tensor parallelism (sharding rules) and sequence/context parallelism
 
 from mmlspark_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
+    PIPELINE_AXIS,
     SEQUENCE_AXIS,
     batch_spec,
     initialize_distributed,
     make_mesh,
     replicated_spec,
+)
+from mmlspark_tpu.parallel.pipeline import (  # noqa: F401
+    PIPELINE_STAGE_RULES,
+    pipeline_apply,
+)
+from mmlspark_tpu.parallel.expert import (  # noqa: F401
+    EXPERT_RULES,
+    moe_ffn,
 )
 from mmlspark_tpu.parallel.context_parallel import (  # noqa: F401
     ring_attention,

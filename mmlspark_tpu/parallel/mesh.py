@@ -20,6 +20,8 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQUENCE_AXIS = "seq"
+PIPELINE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(

@@ -56,6 +56,8 @@ class TrainConfig:
     seed: int = 0
     log_every: int = 50
     shuffle: bool = True
+    # weight on sown auxiliary losses (e.g. MoE load-balance, models/moe.py)
+    moe_aux_weight: float = 1e-2
     # mesh: axis name -> size; None = all devices on the data axis
     mesh_axes: dict | None = None
     # tensor-parallel param sharding rules: ordered (regex, spec_tuple)
@@ -119,11 +121,30 @@ def masked_loss(kind: str, logits, labels, mask):
     return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
+def _sown_aux_loss(variables: dict):
+    """Sum of every value sown into a block's ``losses`` collection (MoE
+    load-balance terms, models/moe.py); 0.0 when none exist."""
+    import jax
+
+    total = 0.0
+    for block_vars in variables.values():
+        if isinstance(block_vars, dict) and "losses" in block_vars:
+            for leaf in jax.tree_util.tree_leaves(block_vars["losses"]):
+                total = total + leaf.sum()
+    return total
+
+
 def _split_variables(variables: dict) -> tuple[dict, dict]:
-    """Per-block variables -> (trainable params tree, static/stats tree)."""
+    """Per-block variables -> (trainable params tree, static/stats tree).
+
+    Sown per-call ``losses`` are consumed by :func:`_sown_aux_loss` before
+    this split and must NOT ride along in ``rest``: they would change the
+    carried tree structure after step 0 (forcing a recompile and breaking
+    checkpoint restore against the init-derived target).
+    """
     params = {b: v.get("params", {}) for b, v in variables.items()}
     rest = {
-        b: {k: c for k, c in v.items() if k != "params"}
+        b: {k: c for k, c in v.items() if k not in ("params", "losses")}
         for b, v in variables.items()
     }
     return params, rest
@@ -220,11 +241,21 @@ class SPMDTrainer:
         graph = self.graph
         loss_kind = cfg.loss
 
+        aux_w = cfg.moe_aux_weight
+        # forward the padding mask only to graphs that accept it (user
+        # duck-typed graphs may predate the mask kwarg)
+        import inspect
+
+        takes_mask = "mask" in inspect.signature(graph.apply).parameters
+
         def step_fn(params, rest, opt_state, bx, by, bmask):
             def loss_fn(p):
                 variables = _merge_variables(p, rest)
-                out, updated = graph.apply(variables, bx, train=True)
+                mask_kw = {"mask": bmask} if takes_mask else {}
+                out, updated = graph.apply(variables, bx, train=True,
+                                           **mask_kw)
                 loss = masked_loss(loss_kind, out, by, bmask)
+                loss = loss + aux_w * _sown_aux_loss(updated)
                 _, new_rest = _split_variables(updated)
                 return loss, new_rest
 
