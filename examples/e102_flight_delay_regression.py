@@ -1,0 +1,55 @@
+"""Notebook-102 parity: TrainRegressor on flight-delay-shaped data.
+
+Reference flow (notebooks/samples/102 - Regression Example with Flight
+Delay Dataset.ipynb): read flight table -> TrainRegressor -> score ->
+ComputeModelStatistics + ComputePerInstanceStatistics. Synthetic
+flight-shaped data stands in for the download.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.stages.eval_metrics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.stages.train_regressor import TrainRegressor
+
+
+def make_flights(n=800, seed=3) -> Dataset:
+    rng = np.random.default_rng(seed)
+    dep_hour = rng.uniform(0, 24, n)
+    distance = rng.uniform(100, 3000, n)
+    carrier = rng.choice(["AA", "UA", "DL", "WN"], n)
+    carrier_delay = {"AA": 5.0, "UA": 8.0, "DL": 2.0, "WN": 10.0}
+    delay = (
+        0.6 * np.maximum(dep_hour - 15, 0) ** 1.5
+        + distance / 500
+        + np.vectorize(carrier_delay.get)(carrier)
+        + rng.normal(0, 3, n)
+    )
+    return Dataset({
+        "dep_hour": dep_hour,
+        "distance": distance,
+        "carrier": list(carrier),
+        "arr_delay": delay,
+    })
+
+
+def main():
+    train, test = make_flights(seed=3), make_flights(n=250, seed=4)
+    model = TrainRegressor(
+        label_col="arr_delay", epochs=120, learning_rate=5e-2
+    ).fit(train)
+    scored = model.transform(test)
+    stats = ComputeModelStatistics().transform(scored)
+    r2 = float(stats["R^2"][0])
+    rmse = float(stats["root_mean_squared_error"][0])
+    per = ComputePerInstanceStatistics().transform(scored)
+    assert r2 > 0.5, f"R^2 {r2} too low"
+    assert per["L2_loss"].min() >= 0
+    print(f"OK {{'R^2': {r2:.3f}, 'RMSE': {rmse:.2f}}}")
+
+
+if __name__ == "__main__":
+    main()

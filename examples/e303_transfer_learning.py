@@ -1,0 +1,84 @@
+"""Notebook-303/305 parity: transfer learning by DNN featurization.
+
+Reference flow (notebooks/samples/303 - Transfer Learning by DNN
+Featurization.ipynb): ImageFeaturizer with a pretrained CNN cut one layer
+from the top -> headless activations as features -> TrainClassifier on the
+features. Here the backbone is a ResNet-20 briefly pre-fitted on a related
+synthetic task (standing in for the model-zoo download), then cut and
+reused to featurize a new two-class image problem.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import ImageRow
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.models import build_model
+from mmlspark_tpu.stages.dnn_model import TPUModel
+from mmlspark_tpu.stages.image import ImageFeaturizer
+from mmlspark_tpu.stages.prep import SelectColumns
+from mmlspark_tpu.stages.train_classifier import TrainClassifier
+from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
+
+
+def blob_images(n, seed, classes=2):
+    """Two visual classes: bright-top vs bright-bottom uint8 images."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    imgs = []
+    for label in y:
+        img = rng.integers(0, 80, (32, 32, 3))
+        half = slice(0, 16) if label == 0 else slice(16, 32)
+        img[half] += 150
+        imgs.append(np.clip(img, 0, 255).astype(np.uint8))
+    return imgs, y
+
+
+def main():
+    # "pretrained" backbone: quick fit so features carry signal
+    graph = build_model("resnet20_cifar10", width=8)
+    imgs, y = blob_images(256, seed=0)
+    x = np.stack(imgs).astype(np.float32) / 255.0
+    # enough steps for the BatchNorm running statistics to converge
+    # (eval mode uses them; momentum 0.9 needs ~50 steps)
+    trainer = SPMDTrainer(
+        graph, TrainConfig(epochs=15, batch_size=64, learning_rate=1e-2,
+                           log_every=20),
+    )
+    variables = trainer.train(x, y.astype(np.int32))
+    backbone = TPUModel.from_graph(
+        graph, variables, "resnet20_cifar10", model_config={"width": 8},
+        input_col="image", output_col="scores",
+    )
+
+    # featurize fresh train/test splits with the headless net (cut the
+    # logits layer); scale matches the backbone's normalization (pix/255)
+    def featurize(seed, n):
+        imgs2, y2 = blob_images(n, seed=seed)
+        ds = Dataset({
+            "image": [ImageRow(path=f"img{i}", data=im)
+                      for i, im in enumerate(imgs2)],
+            "label": [["top", "bottom"][c] for c in y2],
+        })
+        out = ImageFeaturizer(
+            model=backbone, cut_output_layers=1, scale=1.0 / 255.0
+        ).transform(ds)
+        # keep only (features, label) for the downstream learner, as the
+        # notebook does with a select()
+        return SelectColumns(cols=["features", "label"]).transform(out)
+
+    train_f, test_f = featurize(seed=5, n=200), featurize(seed=6, n=100)
+    feat_dim = train_f["features"].shape[1]
+
+    model = TrainClassifier(label_col="label", epochs=20,
+                            learning_rate=5e-2).fit(train_f)
+    scored = model.transform(test_f)
+    acc = float(
+        (np.asarray(scored["scored_labels"])
+         == np.asarray(test_f["label"])).mean()
+    )
+    assert acc > 0.85, f"held-out accuracy {acc} too low"
+    print(f"OK {{'accuracy': {acc:.3f}, 'feature_dim': {feat_dim}}}")
+
+
+if __name__ == "__main__":
+    main()

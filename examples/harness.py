@@ -1,0 +1,104 @@
+"""Run every example headless with a per-script timeout — the analog of
+the reference's notebook test runner (tools/notebook/tester/
+NotebookTestSuite.py: nbconvert ExecutePreprocessor(timeout=600) per
+notebook, PROC_SHARD=i/m sharding at TestNotebooksLocally.py:46-52).
+
+Usage:
+    python examples/harness.py                 # run all e*.py
+    PROC_SHARD=0/2 python examples/harness.py  # run shard 0 of 2
+    python examples/harness.py e301 e304       # run by prefix
+
+Each script runs in its own process on the virtual 8-device CPU mesh so a
+crash or hang in one cannot take down the runner, exactly like the
+reference's per-notebook subprocesses.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+TIMEOUT_S = 600  # NotebookTestSuite.py:13
+
+
+def discover(selectors: list[str], use_shard: bool = True) -> list[str]:
+    root = os.path.dirname(os.path.abspath(__file__))
+    names = sorted(
+        f for f in os.listdir(root)
+        if f.startswith("e") and f.endswith(".py")
+    )
+    if selectors:
+        names = [
+            n for n in names if any(n.startswith(s) for s in selectors)
+        ]
+    shard = os.environ.get("PROC_SHARD") if use_shard else None
+    if shard:
+        i, m = (int(p) for p in shard.split("/"))
+        names = [n for k, n in enumerate(names) if k % m == i]
+    return [os.path.join(root, n) for n in names]
+
+
+def run_one(path: str) -> tuple[bool, float, str]:
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(path)))
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else repo_root
+    )
+    # force the virtual 8-device CPU mesh even when the environment
+    # pre-selects a real backend (same override tests/conftest.py applies)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    # env vars alone are not enough on hosts whose site customization
+    # registers a real accelerator backend; force the platform through
+    # jax.config before the script runs (same override tests/conftest.py
+    # applies in-process)
+    boot = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"runpy.run_path({path!r}, run_name='__main__')"
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", boot],
+            capture_output=True, text=True, timeout=TIMEOUT_S, env=env,
+        )
+        ok = proc.returncode == 0 and "OK" in proc.stdout
+        # on success surface the script's headline OK line; on failure the
+        # last error line
+        src = proc.stdout if ok else (proc.stdout + proc.stderr)
+        tail = src.strip().splitlines()
+        detail = tail[-1] if tail else ""
+    except subprocess.TimeoutExpired:
+        ok, detail = False, f"TIMEOUT after {TIMEOUT_S}s"
+    return ok, time.time() - t0, detail
+
+
+def main() -> int:
+    paths = discover(sys.argv[1:])
+    if not paths:
+        print("no examples matched")
+        return 2
+    failures = 0
+    for path in paths:
+        name = os.path.basename(path)
+        ok, dt, detail = run_one(path)
+        status = "PASS" if ok else "FAIL"
+        print(f"{status} {name} ({dt:.1f}s) {detail}")
+        failures += 0 if ok else 1
+    print(f"{len(paths) - failures}/{len(paths)} examples passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

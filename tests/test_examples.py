@@ -1,0 +1,29 @@
+"""Example-script tier: every examples/e*.py must run headless within the
+per-notebook timeout — the analog of the reference's local notebook tests
+(tools/notebook/tester/TestNotebooksLocally.py: each sample notebook
+executes via nbconvert with a 600 s timeout)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+)
+import harness  # noqa: E402
+
+# ignore PROC_SHARD here: the pytest tier always covers every example
+EXAMPLES = harness.discover([], use_shard=False)
+
+
+def test_examples_discovered():
+    assert len(EXAMPLES) >= 7
+
+
+@pytest.mark.parametrize(
+    "path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES]
+)
+def test_example_runs(path):
+    ok, dt, detail = harness.run_one(path)
+    assert ok, f"{os.path.basename(path)} failed after {dt:.1f}s: {detail}"
