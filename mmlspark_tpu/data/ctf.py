@@ -64,7 +64,16 @@ def read_ctf(
     features_col: str = "features",
 ) -> Dataset:
     """Parse CTF lines back into (label, features) columns. Sparse features
-    require ``feature_dim`` to densify; dense streams infer their width."""
+    require ``feature_dim`` to densify; dense streams infer their width.
+
+    The production path is the native C++ parser (ops/native/ctf.cpp — the
+    role the external ``cntk`` binary's reader block played for the
+    reference); the Python loop below is the fallback and the error-message
+    path for malformed input.
+    """
+    native = _read_ctf_native(path, feature_dim, label_col, features_col)
+    if native is not None:
+        return native
     labels: list[np.ndarray] = []
     feats: list[np.ndarray] = []
     with open(path) as f:
@@ -85,7 +94,54 @@ def read_ctf(
     lab_arr = np.stack(labels) if labels else np.zeros((0, 1))
     if lab_arr.shape[1] == 1:
         lab_arr = lab_arr[:, 0]
-    return Dataset({label_col: lab_arr, features_col: np.stack(feats)})
+    feat_arr = (
+        np.stack(feats) if feats else np.zeros((0, feature_dim or 0))
+    )
+    return Dataset({label_col: lab_arr, features_col: feat_arr})
+
+
+def _read_ctf_native(
+    path: str, feature_dim: int | None, label_col: str, features_col: str
+) -> Dataset | None:
+    """C++ fast path; None -> fall back to the Python parser (which also
+    produces the precise FriendlyError for malformed files)."""
+    import ctypes
+    import os
+
+    from mmlspark_tpu.ops.native_build import load_native
+
+    lib = load_native("ctf")
+    if lib is None or not os.path.exists(path):
+        return None
+    labels_p = ctypes.POINTER(ctypes.c_double)()
+    feats_p = ctypes.POINTER(ctypes.c_double)()
+    lw = ctypes.c_int()
+    fw = ctypes.c_int()
+    rows = ctypes.c_long()
+    rc = lib.mml_parse_ctf(
+        path.encode(), label_col.encode(), features_col.encode(),
+        int(feature_dim or -1),
+        ctypes.byref(labels_p), ctypes.byref(lw),
+        ctypes.byref(feats_p), ctypes.byref(fw), ctypes.byref(rows),
+    )
+    if rc != 0:
+        return None
+    try:
+        n = rows.value
+        lab = np.ctypeslib.as_array(
+            labels_p, shape=(n * lw.value,)
+        ).copy().reshape(n, lw.value) if n else np.zeros((0, 1))
+        ft = np.ctypeslib.as_array(
+            feats_p, shape=(n * fw.value,)
+        ).copy().reshape(n, fw.value) if n else np.zeros(
+            (0, fw.value or 0)
+        )
+    finally:
+        lib.mml_ctf_free(labels_p)
+        lib.mml_ctf_free(feats_p)
+    if lab.shape[1] == 1:
+        lab = lab[:, 0]
+    return Dataset({label_col: lab, features_col: ft})
 
 
 def _parse_values(text: str, dim: int | None) -> np.ndarray:
