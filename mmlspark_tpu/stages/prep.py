@@ -122,14 +122,27 @@ class Timer(Transformer):
 
     stage = Param("wrapped stage", required=True)
     log_to_scala = Param("log timings (name kept for parity)", True, ptype=bool)
+    profile_dir = Param(
+        "when set, also capture a jax.profiler trace of each timed op "
+        "under this directory (TensorBoard/Perfetto viewable)"
+    )
 
     def __init__(self, **kwargs: Any):
         super().__init__(**kwargs)
         self.records: list[dict] = []
 
     def _time(self, what: str, fn, dataset: Dataset):
+        import contextlib
+
+        if self.profile_dir:
+            from mmlspark_tpu.utils.profiling import trace_profile
+
+            ctx: Any = trace_profile(self.profile_dir)
+        else:
+            ctx = contextlib.nullcontext()
         t0 = time.perf_counter()
-        result = fn(dataset)
+        with ctx:
+            result = fn(dataset)
         dt = time.perf_counter() - t0
         rec = {
             "stage": getattr(self.stage, "uid", str(self.stage)),

@@ -1,0 +1,125 @@
+"""API documentation generator.
+
+Role of the reference's codegen doc pipeline (codegen/src/main/scala/
+DocGen.scala + WrapperClassDoc.scala: per-class .rst emitted from
+colocated doc text, assembled into a sphinx tree). The TPU framework's
+Python API is the API (SURVEY.md §7: the codegen layer is an intentional
+architectural delta), so docs generate straight from the live registries:
+every registered pipeline stage's docstring + param table, and every
+registered model builder.
+
+Usage: python tools/docgen.py [output_dir]   (default docs/api)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import defaultdict
+
+# runnable from a checkout: tools/ sits next to the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _import_all() -> None:
+    # importing the packages populates the registries
+    import mmlspark_tpu.stages  # noqa: F401
+    import mmlspark_tpu.models  # noqa: F401
+    import mmlspark_tpu.data.readers  # noqa: F401
+
+
+def _underline(text: str, ch: str) -> str:
+    return f"{text}\n{ch * len(text)}\n"
+
+
+def _param_table(cls) -> list[str]:
+    rows = []
+    for name, p in sorted(cls.params().items()):
+        default = "required" if p.required else repr(p.get_default())
+        domain = " | ".join(p.domain) if p.domain else ""
+        doc = (p.doc or "").replace("\n", " ")
+        rows.append((name, default, domain, doc))
+    if not rows:
+        return ["(no parameters)", ""]
+    widths = [max(len(r[i]) for r in rows + [_HDR]) for i in range(4)]
+
+    def fmt(r):
+        return "  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip()
+
+    sep = tuple("=" * w for w in widths)
+    return [fmt(sep), fmt(_HDR), fmt(sep), *(fmt(r) for r in rows),
+            fmt(sep), ""]
+
+
+_HDR = ("param", "default", "domain", "doc")
+
+
+def generate(out_dir: str) -> list[str]:
+    """Write one .rst per stage module + models.rst + index.rst; returns
+    the written paths."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.models.registry import registered_models
+
+    _import_all()
+    os.makedirs(out_dir, exist_ok=True)
+    by_module: dict[str, list[type]] = defaultdict(list)
+    for name, cls in sorted(PipelineStage.registry().items()):
+        mod = cls.__module__.rsplit(".", 1)[-1]
+        by_module[mod].append(cls)
+
+    written = []
+    for mod, classes in sorted(by_module.items()):
+        lines = [_underline(mod, "="), ""]
+        for cls in classes:
+            lines.append(_underline(cls.__name__, "-"))
+            # own docstring, else the module overview (many stage classes
+            # document the family at module level, like the reference's
+            # colocated .txt doc files)
+            doc = cls.__dict__.get("__doc__")
+            if not doc:
+                module = sys.modules.get(cls.__module__)
+                mod_doc = (module.__doc__ or "") if module else ""
+                doc = mod_doc.split("\n\n")[0] or "(undocumented)"
+            lines.append(doc.strip())
+            lines.append("")
+            lines.extend(_param_table(cls))
+        path = os.path.join(out_dir, f"{mod}.rst")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        written.append(path)
+
+    # model registry page
+    lines = [_underline("models", "="), "",
+             "Registered model architectures (``build_model`` names):", ""]
+    for name in registered_models():
+        from mmlspark_tpu.models.registry import _BUILDERS
+
+        fn = _BUILDERS[name]
+        doc = (fn.__doc__ or "(undocumented)").strip().replace("\n", " ")
+        lines.append(f"``{name}``")
+        lines.append(f"    {doc}")
+        lines.append("")
+    path = os.path.join(out_dir, "models.rst")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    written.append(path)
+
+    # index
+    entries = "\n".join(
+        f"   {os.path.splitext(os.path.basename(p))[0]}" for p in written
+    )
+    index = os.path.join(out_dir, "index.rst")
+    with open(index, "w") as f:
+        f.write(
+            _underline("API reference", "=")
+            + "\n.. toctree::\n   :maxdepth: 1\n\n"
+            + entries + "\n"
+        )
+    written.append(index)
+    return written
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "docs/api"
+    paths = generate(out)
+    print(f"wrote {len(paths)} files under {out}")
