@@ -1,21 +1,53 @@
-"""Benchmark: flagship CIFAR-10 CNN inference throughput per chip.
+"""Benchmark: north-star metrics on real TPU hardware.
 
-North-star metric #1 from BASELINE.json ("CIFAR-10 CNN images/sec/chip" —
-reference notebook 301 runs the same eval through CNTKModel with JNI copies
-per 10-row minibatch, CNTKModel.scala:51-88,205). The reference publishes no
-numbers (BASELINE.md), so ``vs_baseline`` is reported against this repo's
-own first recorded value once one exists (BENCH_r1.json onward); until then
-it is null.
+Metric 1 (primary): CIFAR-10 ResNet-20 inference images/sec/chip — the
+reference runs the same eval through CNTKModel with JNI copies per 10-row
+minibatch (CNTKModel.scala:51-88,205). Also derives MFU from the compiled
+program's XLA flop count and the chip's published bf16 peak.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric 2: TrainClassifier epoch time on an Adult-Census-shaped dataset
+(BASELINE.md north-star #2; reference notebook 101). Measured as the
+marginal cost of extra epochs so featurize + compile time cancels out.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` stays
+null until this repo's own first recorded value exists.
+
+Resilience: TPU backend init through the tunnel can fail transiently
+(BENCH_r01 died this way with nothing recorded). This script retries by
+re-exec'ing itself with backoff, and on final failure emits a diagnostic
+JSON line instead of a bare traceback — the driver always gets one line.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+import traceback
 
 import numpy as np
+
+_ATTEMPT_ENV = "MMLTPU_BENCH_ATTEMPT"
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = (5, 15, 30)
+
+#: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
+_PEAK_FLOPS = (
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e: 197 bf16 TFLOP/s (394 is the int8 figure)
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+#: analytic fallback if XLA cost analysis is unavailable:
+#: ResNet-20 CIFAR forward ~40.6M MACs -> 81.2 MFLOPs/image
+_RESNET20_FLOPS_PER_IMAGE = 81.2e6
 
 
 def _timed(fn) -> float:
@@ -24,10 +56,16 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
 
+
+def bench_inference(jax, jnp) -> dict:
+    """Images/sec/chip + MFU for ResNet-20 CIFAR inference."""
     from mmlspark_tpu.models import build_model
 
     graph = build_model("resnet20_cifar10")
@@ -69,23 +107,164 @@ def main() -> None:
     np.asarray(fwd(variables, x))  # warmup / compile
 
     # best of 3 timed trials: single-trial numbers swing with relay/tunnel
-    # noise, and the max is the cleanest estimate of device throughput
-    dt = min(
-        _timed(lambda: np.asarray(fwd(variables, x))) for _ in range(3)
-    )
+    # noise, so the *min* elapsed (= max throughput) is the cleanest
+    # estimate of device capability
+    dt = min(_timed(lambda: np.asarray(fwd(variables, x))) for _ in range(3))
 
     images_per_sec = batch * iters / dt
     per_chip = images_per_sec / jax.device_count()
-    result = {
+
+    # FLOPs/image from XLA cost analysis of ONE forward pass (the chained
+    # program can't be used: cost_analysis counts a lax.scan body once, not
+    # times the trip count), falling back to the analytic ResNet-20 estimate
+    flops_per_image = None
+    try:
+        one_fwd = jax.jit(graph.apply)
+        cost = one_fwd.lower(variables, x).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        if flops > 0:
+            flops_per_image = flops / batch
+    except Exception:
+        pass
+    flops_source = "xla_cost_analysis"
+    if not flops_per_image:
+        flops_per_image, flops_source = _RESNET20_FLOPS_PER_IMAGE, "analytic"
+
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    mfu = per_chip * flops_per_image / peak if peak else None
+    return {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_image": round(flops_per_image),
+        "flops_source": flops_source,
+        "device_kind": kind,
+        "peak_bf16_flops": peak,
+        "batch": batch,
+        "input_dtype": "bfloat16",
+        "timing": "best-of-3 trials, 60 scan-chained iters, host-fetch sync",
+    }
+
+
+def _make_census(n: int, seed: int):
+    """Adult-Census-shaped synthetic table (notebook 101 schema shape)."""
+    from mmlspark_tpu.data.dataset import Dataset
+
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(18, 80, n)
+    hours = rng.uniform(10, 60, n)
+    fnlwgt = rng.uniform(1e4, 1e6, n)
+    edu_num = rng.integers(1, 16, n).astype(np.float64)
+    gain = rng.exponential(500.0, n)
+    loss = rng.exponential(80.0, n)
+    edu = rng.choice(["hs", "college", "bachelors", "masters", "phd"], n)
+    occ = rng.choice(
+        ["clerical", "exec", "tech", "service", "sales", "craft"], n
+    )
+    marital = rng.choice(["married", "single", "divorced"], n)
+    rel = rng.choice(["husband", "wife", "own-child", "unmarried"], n)
+    race = rng.choice(["a", "b", "c", "d"], n)
+    sex = rng.choice(["m", "f"], n)
+    country = rng.choice(["us", "mx", "ph", "de", "other"], n)
+    wc = rng.choice(["private", "gov", "self"], n)
+    score = (
+        (age - 40) / 20
+        + (hours - 35) / 15
+        + (edu_num - 8) / 6
+        + (edu == "phd") * 1.5
+    )
+    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
+    return Dataset({
+        "age": age,
+        "hours_per_week": hours,
+        "fnlwgt": fnlwgt,
+        "education_num": edu_num,
+        "capital_gain": gain,
+        "capital_loss": loss,
+        "education": list(edu),
+        "occupation": list(occ),
+        "marital_status": list(marital),
+        "relationship": list(rel),
+        "race": list(race),
+        "sex": list(sex),
+        "native_country": list(country),
+        "workclass": list(wc),
+        "income": list(label),
+    })
+
+
+def bench_train_classifier(jax) -> dict:
+    """Seconds per TrainClassifier epoch, Adult-Census-shaped (32561 rows —
+    the real Adult train-split size)."""
+    from mmlspark_tpu.stages.train_classifier import TrainClassifier
+
+    n = 32561
+    ds = _make_census(n, seed=7)
+
+    def fit(epochs: int) -> float:
+        tc = TrainClassifier(
+            label_col="income", epochs=epochs, batch_size=256, seed=0
+        )
+        return _timed(lambda: tc.fit(ds))
+
+    fit(1)  # warmup: pays featurize + train-step compile
+    t1 = fit(1)
+    t5 = fit(5)
+    # marginal epoch cost: featurization + jit-cache-hit overheads cancel
+    epoch_s = max((t5 - t1) / 4.0, 1e-9)
+    return {
+        "train_epoch_seconds": round(epoch_s, 3),
+        "train_fit_1epoch_seconds": round(t1, 3),
+        "train_rows": n,
+        "train_batch_size": 256,
+        "epoch_timing": "(fit(5 epochs) - fit(1 epoch)) / 4, post-warmup",
+    }
+
+
+def run() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    jax.devices()  # force backend init inside the retry envelope
+    inf = bench_inference(jax, jnp)
+    train = bench_train_classifier(jax)
+    return {
         "metric": "cifar10_resnet20_inference_images_per_sec_per_chip",
-        "value": round(per_chip, 1),
+        "value": inf.pop("images_per_sec_per_chip"),
         "unit": "images/sec/chip",
         "vs_baseline": None,
         "devices": jax.device_count(),
         "backend": jax.default_backend(),
-        "batch": batch,
+        **inf,
+        **train,
     }
-    print(json.dumps(result))
+
+
+def main() -> None:
+    attempt = int(os.environ.get(_ATTEMPT_ENV, "1"))
+    try:
+        print(json.dumps(run()))
+        return
+    except Exception as e:  # noqa: BLE001 — last-line diagnostics by design
+        traceback.print_exc()
+        if attempt < _MAX_ATTEMPTS:
+            time.sleep(_BACKOFF_S[min(attempt - 1, len(_BACKOFF_S) - 1)])
+            env = dict(os.environ, **{_ATTEMPT_ENV: str(attempt + 1)})
+            # fresh process: jax caches a failed backend for the life of
+            # the interpreter, so in-process retry would see the same error
+            os.execve(sys.executable, [sys.executable, __file__], env)
+        print(
+            json.dumps({
+                "metric": "cifar10_resnet20_inference_images_per_sec_per_chip",
+                "value": None,
+                "unit": "images/sec/chip",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {e}",
+                "attempts": attempt,
+            })
+        )
 
 
 if __name__ == "__main__":
