@@ -148,60 +148,14 @@ def bench_inference(jax, jnp) -> dict:
     }
 
 
-def _make_census(n: int, seed: int):
-    """Adult-Census-shaped synthetic table (notebook 101 schema shape)."""
-    from mmlspark_tpu.data.dataset import Dataset
-
-    rng = np.random.default_rng(seed)
-    age = rng.uniform(18, 80, n)
-    hours = rng.uniform(10, 60, n)
-    fnlwgt = rng.uniform(1e4, 1e6, n)
-    edu_num = rng.integers(1, 16, n).astype(np.float64)
-    gain = rng.exponential(500.0, n)
-    loss = rng.exponential(80.0, n)
-    edu = rng.choice(["hs", "college", "bachelors", "masters", "phd"], n)
-    occ = rng.choice(
-        ["clerical", "exec", "tech", "service", "sales", "craft"], n
-    )
-    marital = rng.choice(["married", "single", "divorced"], n)
-    rel = rng.choice(["husband", "wife", "own-child", "unmarried"], n)
-    race = rng.choice(["a", "b", "c", "d"], n)
-    sex = rng.choice(["m", "f"], n)
-    country = rng.choice(["us", "mx", "ph", "de", "other"], n)
-    wc = rng.choice(["private", "gov", "self"], n)
-    score = (
-        (age - 40) / 20
-        + (hours - 35) / 15
-        + (edu_num - 8) / 6
-        + (edu == "phd") * 1.5
-    )
-    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
-    return Dataset({
-        "age": age,
-        "hours_per_week": hours,
-        "fnlwgt": fnlwgt,
-        "education_num": edu_num,
-        "capital_gain": gain,
-        "capital_loss": loss,
-        "education": list(edu),
-        "occupation": list(occ),
-        "marital_status": list(marital),
-        "relationship": list(rel),
-        "race": list(race),
-        "sex": list(sex),
-        "native_country": list(country),
-        "workclass": list(wc),
-        "income": list(label),
-    })
-
-
 def bench_train_classifier(jax) -> dict:
     """Seconds per TrainClassifier epoch, Adult-Census-shaped (32561 rows —
-    the real Adult train-split size)."""
+    the real Adult train-split size, full 14-feature schema)."""
     from mmlspark_tpu.stages.train_classifier import TrainClassifier
+    from mmlspark_tpu.testing.datagen import make_census
 
     n = 32561
-    ds = _make_census(n, seed=7)
+    ds = make_census(n, seed=7, full_schema=True)
 
     def fit(epochs: int) -> float:
         tc = TrainClassifier(

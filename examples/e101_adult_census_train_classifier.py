@@ -8,37 +8,27 @@ synthetic census-shaped data (no network egress in this environment).
 
 import tempfile
 
-import numpy as np
-
 from mmlspark_tpu.core.stage import PipelineStage
-from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
 from mmlspark_tpu.stages.train_classifier import TrainClassifier
-
-
-def make_census(n=600, seed=7) -> Dataset:
-    rng = np.random.default_rng(seed)
-    age = rng.uniform(18, 80, n)
-    hours = rng.uniform(10, 60, n)
-    edu = rng.choice(["hs", "college", "phd"], n)
-    occupation = rng.choice(["clerical", "exec", "tech", "service"], n)
-    score = (age - 40) / 20 + (hours - 35) / 15 + (edu == "phd") * 1.5
-    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
-    return Dataset({
-        "age": age,
-        "hours_per_week": hours,
-        "education": list(edu),
-        "occupation": list(occupation),
-        "income": list(label),
-    })
+from mmlspark_tpu.testing.datagen import make_census
 
 
 def main():
+    from mmlspark_tpu.stages.find_best import FindBestModel
+
     train, test = make_census(seed=7), make_census(n=200, seed=8)
 
-    model = TrainClassifier(
-        label_col="income", epochs=25, learning_rate=5e-2
-    ).fit(train)
+    # three learner families, like the notebook's LR/GBT/RF sweep ranked
+    # with FindBestModel (notebook 101 cells 4-6)
+    candidates = [
+        TrainClassifier(
+            label_col="income", model=name, epochs=25, learning_rate=5e-2
+        ).fit(train)
+        for name in ("logistic_regression", "gbt", "random_forest")
+    ]
+    best = FindBestModel(models=candidates, evaluation_metric="AUC").fit(test)
+    model = best.best_model
 
     # save/load round trip (the notebook persists to wasb://)
     with tempfile.TemporaryDirectory() as d:
@@ -50,7 +40,11 @@ def main():
     acc = float(stats["accuracy"][0])
     auc = float(stats["AUC"][0])
     assert acc > 0.75, f"accuracy {acc} too low"
-    print(f"OK {{'accuracy': {acc:.3f}, 'AUC': {auc:.3f}}}")
+    table = best.all_model_metrics
+    print(
+        f"OK {{'accuracy': {acc:.3f}, 'AUC': {auc:.3f}, "
+        f"'candidates': {len(table)}}}"
+    )
 
 
 if __name__ == "__main__":

@@ -89,14 +89,31 @@ def read_ctf(
                 raise FriendlyError(
                     f"CTF line missing |{label_col} or |{features_col}: {raw[:80]}"
                 )
-            labels.append(_parse_values(fields[label_col], None))
-            feats.append(_parse_values(fields[features_col], feature_dim))
-    lab_arr = np.stack(labels) if labels else np.zeros((0, 1))
+            try:
+                labels.append(_parse_values(fields[label_col], None))
+                feats.append(_parse_values(fields[features_col], feature_dim))
+            except FriendlyError:
+                raise
+            except (ValueError, IndexError) as e:
+                raise FriendlyError(
+                    f"malformed CTF line ({e}): {raw[:80]}"
+                ) from e
+    try:
+        lab_arr = np.stack(labels) if labels else np.zeros((0, 1))
+    except ValueError as e:
+        raise FriendlyError(
+            f"ragged CTF label rows (widths differ across lines): {e}"
+        ) from e
     if lab_arr.shape[1] == 1:
         lab_arr = lab_arr[:, 0]
-    feat_arr = (
-        np.stack(feats) if feats else np.zeros((0, feature_dim or 0))
-    )
+    try:
+        feat_arr = (
+            np.stack(feats) if feats else np.zeros((0, feature_dim or 0))
+        )
+    except ValueError as e:
+        raise FriendlyError(
+            f"ragged CTF feature rows (widths differ across lines): {e}"
+        ) from e
     return Dataset({label_col: lab_arr, features_col: feat_arr})
 
 

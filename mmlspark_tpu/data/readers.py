@@ -22,7 +22,6 @@ is salted per process and would break this.)
 from __future__ import annotations
 
 import fnmatch
-import io
 import os
 import zipfile
 import zlib

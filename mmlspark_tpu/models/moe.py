@@ -22,7 +22,13 @@ import jax.numpy as jnp
 from mmlspark_tpu.core.exceptions import ParamError
 from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph
 from mmlspark_tpu.models.registry import register_model
-from mmlspark_tpu.models.transformer import LMHead, SelfAttention, TokenPosEmbed
+from mmlspark_tpu.models.transformer import (
+    AUTO,
+    LMHead,
+    SelfAttention,
+    TokenPosEmbed,
+    resolve_attn_impl,
+)
 from mmlspark_tpu.parallel.expert import moe_ffn, validate_experts
 
 
@@ -80,13 +86,15 @@ class MoEBlock(nn.Module):
     d_ff: int
     causal: bool
     capacity_factor: float
+    attn_impl: str = AUTO
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, mask=None):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(self.heads, self.head_dim, self.causal,
-                              "dense", None, self.dtype, name="attn")(y)
+                              resolve_attn_impl(self.attn_impl), None,
+                              self.dtype, name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = MoEFFN(self.n_experts, self.d_ff, self.capacity_factor,
                    self.dtype, name="moe")(y, mask)
@@ -104,11 +112,18 @@ def transformer_lm_moe(
     max_len: int = 512,
     causal: bool = True,
     capacity_factor: float = 1.25,
+    attn_impl: str = AUTO,
     mesh: Any = None,
 ) -> NamedGraph:
     """Decoder-only switch-MoE LM; every block's FFN is expert-routed."""
     if d_model % heads:
         raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
+    from mmlspark_tpu.models.transformer import ATTN_IMPLS
+
+    if attn_impl not in ATTN_IMPLS:
+        raise ParamError(
+            f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
+        )
     validate_experts(n_experts, mesh)
     d_ff = d_ff or 4 * d_model
     blocks: list[tuple[str, Any]] = [
@@ -119,7 +134,7 @@ def transformer_lm_moe(
             (
                 f"block{i}",
                 MoEBlock(heads, d_model // heads, n_experts, d_ff, causal,
-                         capacity_factor),
+                         capacity_factor, attn_impl),
             )
         )
     blocks.append((FINAL_NODE, LMHead(vocab_size)))

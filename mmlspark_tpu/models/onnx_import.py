@@ -23,7 +23,7 @@ Registered as model ``"onnx"`` (config: ``path``) so serialized
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from typing import Any
 
 import numpy as np
 

@@ -15,8 +15,7 @@ Design notes (pallas_guide / scaling-book mental model):
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Sequence
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -33,7 +33,19 @@ DENSE = "dense"
 RING = "ring"
 ULYSSES = "ulysses"
 FLASH = "flash"
-ATTN_IMPLS = (DENSE, RING, ULYSSES, FLASH)
+AUTO = "auto"
+ATTN_IMPLS = (DENSE, RING, ULYSSES, FLASH, AUTO)
+
+
+def resolve_attn_impl(attn_impl: str) -> str:
+    """``auto`` -> the Pallas flash kernel on TPU (O(S·d) memory both
+    directions, ops/flash_attention.py), XLA dense elsewhere (the
+    interpreter-mode kernel would crawl on CPU test meshes)."""
+    if attn_impl != AUTO:
+        return attn_impl
+    import jax
+
+    return FLASH if jax.default_backend() == "tpu" else DENSE
 
 
 class TokenPosEmbed(nn.Module):
@@ -69,26 +81,30 @@ class SelfAttention(nn.Module):
         qkv = nn.Dense(3 * h * d, dtype=self.dtype,
                        param_dtype=jnp.float32, name="qkv")(x)
         q, k, v = jnp.split(qkv.reshape(b, t, 3 * h, d), 3, axis=2)
-        if self.attn_impl == FLASH:
+        if self.attn_impl not in ATTN_IMPLS:
+            raise ParamError(
+                f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
+            )
+        impl = resolve_attn_impl(self.attn_impl)
+        if impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 
             o = flash_attention(q, k, v, causal=self.causal)
-        elif self.attn_impl == DENSE or self.mesh is None:
+        elif impl == DENSE or self.mesh is None:
+            # ring/ulysses degrade to dense when no mesh is provided
             o = dense_attention(q, k, v, causal=self.causal)
-        elif self.attn_impl == RING:
+        elif impl == RING:
             from mmlspark_tpu.parallel.context_parallel import ring_attention
 
             o = ring_attention(q, k, v, self.mesh, causal=self.causal)
-        elif self.attn_impl == ULYSSES:
+        elif impl == ULYSSES:
             from mmlspark_tpu.parallel.context_parallel import (
                 ulysses_attention,
             )
 
             o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)
-        else:
-            raise ParamError(
-                f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
-            )
+        else:  # unreachable: impl validated + resolved above
+            raise ParamError(f"unhandled attn_impl '{impl}'")
         return nn.Dense(x.shape[-1], dtype=self.dtype,
                         param_dtype=jnp.float32, name="attn_out")(
             o.reshape(b, t, h * d)
@@ -141,7 +157,7 @@ def transformer_lm(
     d_ff: int = 0,
     max_len: int = 512,
     causal: bool = True,
-    attn_impl: str = DENSE,
+    attn_impl: str = AUTO,
     mesh: Any = None,
 ) -> NamedGraph:
     """Decoder-only LM (or bidirectional encoder with ``causal=False``);
@@ -153,6 +169,7 @@ def transformer_lm(
         raise ParamError(
             f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
         )
+    attn_impl = resolve_attn_impl(attn_impl)
     d_ff = d_ff or 4 * d_model
     blocks: list[tuple[str, Any]] = [
         ("embed", TokenPosEmbed(vocab_size, d_model, max_len))

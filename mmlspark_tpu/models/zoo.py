@@ -79,13 +79,14 @@ class Repository:
         self.root = root.removeprefix("file://")
 
     def _read(self, rel: str) -> bytes:
-        path = os.path.join(self.root, rel)
         if self.root.startswith(("http://", "https://")):
-            from urllib.request import urlopen  # zero-egress envs will fail
+            from urllib.parse import quote
+            from urllib.request import urlopen
 
-            with urlopen(f"{self.root.rstrip('/')}/{rel}") as r:  # noqa: S310
+            url = f"{self.root.rstrip('/')}/{quote(rel)}"
+            with urlopen(url) as r:  # noqa: S310
                 return r.read()
-        with open(path, "rb") as f:
+        with open(os.path.join(self.root, rel), "rb") as f:
             return f.read()
 
     def list_schemas(self) -> Iterator[ModelSchema]:
@@ -151,9 +152,37 @@ class ModelDownloader:
                 shutil.rmtree(dst)
             shutil.copytree(src, dst)
         else:
-            os.makedirs(os.path.dirname(dst) or self.local_repo, exist_ok=True)
-            with open(dst, "wb") as f:
-                f.write(self.remote._read(schema.uri))
+            # non-filesystem remote: directory payloads list their files in
+            # a '<uri>.files' sidecar (written by publish_model)
+            try:
+                listing = self.remote._read(f"{schema.uri}.files").decode()
+                # one path per line (mirrors the publish_model writer);
+                # paths may contain spaces
+                rels = [ln for ln in listing.splitlines() if ln.strip()]
+            except OSError:
+                rels = None
+            if rels:
+                if os.path.exists(dst):
+                    shutil.rmtree(dst)
+                dst_root = os.path.realpath(dst)
+                for rel in rels:
+                    fpath = os.path.realpath(os.path.join(dst, rel))
+                    # remote-supplied listing: refuse anything escaping the
+                    # payload directory (e.g. '../..' traversal)
+                    if not fpath.startswith(dst_root + os.sep):
+                        raise FriendlyError(
+                            f"model '{name}': unsafe path {rel!r} in "
+                            f"remote file listing"
+                        )
+                    os.makedirs(os.path.dirname(fpath), exist_ok=True)
+                    with open(fpath, "wb") as f:
+                        f.write(self.remote._read(f"{schema.uri}/{rel}"))
+            else:
+                os.makedirs(
+                    os.path.dirname(dst) or self.local_repo, exist_ok=True
+                )
+                with open(dst, "wb") as f:
+                    f.write(self.remote._read(schema.uri))
         if not self._verify(schema):
             raise FriendlyError(
                 f"sha256 mismatch for model '{name}' (corrupt download)"
@@ -191,15 +220,19 @@ def publish_model(
             shutil.copytree(payload_path, dst)
         else:
             shutil.copy2(payload_path, dst)
-    size = (
-        os.path.getsize(dst)
-        if os.path.isfile(dst)
-        else sum(
-            os.path.getsize(os.path.join(r, f))
+    if os.path.isdir(dst):
+        rels = sorted(
+            os.path.relpath(os.path.join(r, f), dst)
             for r, _d, fs in os.walk(dst)
             for f in fs
         )
-    )
+        size = sum(os.path.getsize(os.path.join(dst, rel)) for rel in rels)
+        # file-list sidecar: lets http(s) repos fetch directory payloads
+        # file-by-file (a filesystem repo just copytrees)
+        with open(os.path.join(repo_root, f"{base}.files"), "w") as f:
+            f.write("\n".join(rels) + "\n")
+    else:
+        size = os.path.getsize(dst)
     schema = ModelSchema(
         name=name,
         uri=base,

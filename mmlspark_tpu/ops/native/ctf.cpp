@@ -39,18 +39,21 @@ struct Field {
   const char* end = nullptr;
 };
 
-// find "|<name> values..." within [line, line_end); values exclude the name
+// find "|<name> values..." within [line, line_end); values exclude the name.
+// Mirrors the Python fallback's dict semantics exactly: the field name runs
+// to the first SPACE (' ' only — a tab stays part of the name, like
+// str.partition(" ")), and when a name repeats the LAST occurrence wins.
 bool find_field(const char* line, const char* line_end,
                 const char* name, size_t name_len, Field* out) {
+  bool found = false;
   const char* p = line;
   while (p < line_end) {
     const char* bar = static_cast<const char*>(
         memchr(p, '|', static_cast<size_t>(line_end - p)));
-    if (!bar) return false;
+    if (!bar) break;
     const char* fname = bar + 1;
     const char* fend = fname;
-    while (fend < line_end && !isspace(static_cast<unsigned char>(*fend)))
-      ++fend;
+    while (fend < line_end && *fend != ' ' && *fend != '|') ++fend;
     const char* vend = static_cast<const char*>(
         memchr(fend, '|', static_cast<size_t>(line_end - fend)));
     if (!vend) vend = line_end;
@@ -58,11 +61,11 @@ bool find_field(const char* line, const char* line_end,
         memcmp(fname, name, name_len) == 0) {
       out->begin = fend;
       out->end = vend;
-      return true;
+      found = true;  // keep scanning: last duplicate wins
     }
     p = vend;
   }
-  return false;
+  return found;
 }
 
 // parse "v v v" (dense) or "i:v i:v" (sparse, dim>0) into row; returns

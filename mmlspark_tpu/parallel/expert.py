@@ -105,19 +105,28 @@ def moe_ffn(x, gate_w, w_in, b_in, w_out, b_out, *,
     e = w_in.shape[0]
     n = b * t
     flat = x.reshape(n, d)
-    tok_mask = None
-    if mask is not None:
-        tok_mask = jnp.repeat(mask.astype(jnp.float32), t)
-    # group tokens: largest divisor of n that is <= group_size
-    s = next(g for g in range(min(group_size, n), 0, -1) if n % g == 0)
-    g = n // s
+    tok_mask = (
+        jnp.repeat(mask.astype(jnp.float32), t)
+        if mask is not None
+        else jnp.ones(n, jnp.float32)
+    )
+    # pad the token dim up to a multiple of the group size: masked padding
+    # tokens route nowhere and consume no capacity, so group size stays at
+    # the target for ANY batch x seq shape (a divisor-of-n scheme
+    # degenerates to 1-token groups when n is prime, making the capacity
+    # bound vacuous)
+    s = min(group_size, n)
+    pad = (-n) % s
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+        tok_mask = jnp.pad(tok_mask, (0, pad))
+    g = (n + pad) // s
     capacity = max(int(capacity_factor * s / e), 1)
     probs = router_probs(flat, gate_w).reshape(g, s, e)
-    gmask = None if tok_mask is None else tok_mask.reshape(g, s)
+    gmask = tok_mask.reshape(g, s)
     dispatch, combine, aux = jax.vmap(
         lambda p, m: moe_dispatch(p, capacity, m)
-    )(probs, gmask if gmask is not None
-      else jnp.ones((g, s), jnp.float32))
+    )(probs, gmask)
     aux = aux.mean()
     grouped = flat.reshape(g, s, d)
     # scatter: (G, S, E, C) × (G, S, D) -> (G, E, C, D); sharded over
@@ -128,8 +137,9 @@ def moe_ffn(x, gate_w, w_in, b_in, w_out, b_out, *,
     h = jax.nn.gelu(h + b_in[None, :, None, :].astype(x.dtype))
     y = jnp.einsum("gecf,efd->gecd", h, w_out.astype(x.dtype))
     y = y + b_out[None, :, None, :].astype(x.dtype)
-    # gather back, gate-weighted
+    # gather back, gate-weighted; drop the padding tokens
     out = jnp.einsum("gsec,gecd->gsd", combine, y.astype(jnp.float32))
+    out = out.reshape((n + pad), d)[:n]
     return out.reshape(b, t, d).astype(x.dtype), aux
 
 

@@ -12,6 +12,8 @@ _STAGE_MODULES = [
     "value_indexer",
     "featurize",
     "text",
+    "trees",
+    "classical",
     "train_classifier",
     "train_regressor",
     "eval_metrics",

@@ -12,8 +12,6 @@ wrapped as a :class:`~mmlspark_tpu.stages.dnn_model.TPUModel` — the same
 
 from __future__ import annotations
 
-from typing import Any
-
 import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
@@ -99,7 +97,8 @@ class DNNLearner(Estimator, HasFeaturesCol, HasLabelCol):
                 config["num_outputs"] = max(n_classes, 2)
         graph = build_model(self.model_name, **config)
         trainer = SPMDTrainer(graph, self._train_config())
-        if np.issubdtype(np.asarray(y).dtype, np.floating) and self.loss == SOFTMAX_XENT:
+        y_float = np.issubdtype(np.asarray(y).dtype, np.floating)
+        if y_float and self.loss == SOFTMAX_XENT:
             y = y.astype(np.int32)
         variables = trainer.train(
             x.astype(np.float32) if np.issubdtype(x.dtype, np.floating) else x,

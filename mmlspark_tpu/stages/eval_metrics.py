@@ -17,7 +17,7 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError, SchemaError
 from mmlspark_tpu.core.metrics_contracts import MetricData
-from mmlspark_tpu.core.params import HasParams, Param
+from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.schema import (
     CLASSIFICATION,
     REGRESSION,

@@ -29,7 +29,7 @@ from mmlspark_tpu.utils.text import tokenize as _shared_tokenize
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.params import Param, positive
-from mmlspark_tpu.core.schema import ColumnMeta, ImageRow
+from mmlspark_tpu.core.schema import ImageRow
 from mmlspark_tpu.core.stage import Estimator, Model
 from mmlspark_tpu.data.dataset import Dataset
 
@@ -250,6 +250,10 @@ class Featurize(Estimator):
     )
     one_hot_encode_categoricals = Param("one-hot categoricals", True, ptype=bool)
     allow_images = Param("featurize image columns", False, ptype=bool)
+    standardize = Param(
+        "z-score numeric/datetime blocks (pass-through to AssembleFeatures)",
+        True, ptype=bool,
+    )
 
     def _fit(self, dataset: Dataset) -> "FeaturizeModel":
         mapping = self.feature_columns or {"features": list(dataset.columns)}
@@ -261,6 +265,7 @@ class Featurize(Estimator):
                 number_of_features=self.number_of_features,
                 one_hot_encode_categoricals=self.one_hot_encode_categoricals,
                 allow_images=self.allow_images,
+                standardize=self.standardize,
             )
             models.append(assembler.fit(dataset))
         return FeaturizeModel(models=models)

@@ -12,7 +12,7 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.params import Param
-from mmlspark_tpu.core.stage import Estimator, Model, Transformer
+from mmlspark_tpu.core.stage import Estimator, Model
 from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
 

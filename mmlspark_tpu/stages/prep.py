@@ -200,7 +200,8 @@ class CleanMissingData(Estimator):
             if self.cleaning_mode == self.MEAN:
                 fills[c] = float(np.nanmean(arr)) if not np.all(np.isnan(arr)) else 0.0
             elif self.cleaning_mode == self.MEDIAN:
-                fills[c] = float(np.nanmedian(arr)) if not np.all(np.isnan(arr)) else 0.0
+                all_nan = np.all(np.isnan(arr))
+                fills[c] = float(np.nanmedian(arr)) if not all_nan else 0.0
             else:
                 if self.custom_value is None:
                     raise FriendlyError("Custom mode needs custom_value", self.uid)
@@ -245,7 +246,6 @@ class DataConversion(Transformer):
     }
 
     def _transform(self, dataset: Dataset) -> Dataset:
-        from mmlspark_tpu.core.schema import CategoricalMeta, ColumnMeta
 
         out = dataset
         for c in self.cols:
@@ -352,11 +352,13 @@ class SummarizeData(Transformer):
                     put("Missing Value Count",
                         int(np.isnan(f).sum()) if f is not None else 0)
             if self.basic:
-                put("Min", float(valid.min()) if valid is not None and len(valid) else np.nan)
-                put("Max", float(valid.max()) if valid is not None and len(valid) else np.nan)
-                put("Mean", float(valid.mean()) if valid is not None and len(valid) else np.nan)
+                have = valid is not None and len(valid) > 0
+                put("Min", float(valid.min()) if have else np.nan)
+                put("Max", float(valid.max()) if have else np.nan)
+                put("Mean", float(valid.mean()) if have else np.nan)
                 put("Standard Deviation",
-                    float(valid.std(ddof=1)) if valid is not None and len(valid) > 1 else np.nan)
+                    float(valid.std(ddof=1))
+                    if have and len(valid) > 1 else np.nan)
             if self.sample:
                 if valid is not None and len(valid) > 2:
                     m = valid.mean()

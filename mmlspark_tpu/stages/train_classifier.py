@@ -8,18 +8,20 @@ Pipeline reproduced feature-for-feature:
 - auto-Featurize of all non-label columns, learner-aware config (2^18
   features default, 2^12 for NN learners; no OHE for tree learners —
   :107,186-201)
-- the learner is just another estimator; built-in TPU learners are
-  logistic regression / MLP (SPMD-trained); the reference's tree/GBT
-  learners have no TPU story and are an explicit scope decision
-  (SURVEY.md §7 hard parts) — a host-side learner can be plugged in as a
-  custom estimator
+- the learner is just another estimator; built-ins mirror the reference's
+  full dispatch list (TrainClassifier.scala:45-52): logistic regression /
+  MLP (SPMD-trained), decision tree / random forest / GBT (histogram
+  trees built with XLA segment-sums, stages/trees.py), and naive Bayes;
+  a custom Estimator plugs in the same way. Delta vs reference: our
+  logistic regression and GBT are natively multiclass (softmax), so the
+  OneVsRest wrap the reference needs at :110-122 is unnecessary — the
+  OneVsRest combinator still exists (stages/classical.py) for wrapping
+  binary-only custom learners.
 - output model = featurizer + learner + score-column metadata tagging
   (TrainedClassifierModel.transform, :297-348)
 """
 
 from __future__ import annotations
-
-from typing import Any
 
 import numpy as np
 
@@ -44,10 +46,18 @@ from mmlspark_tpu.stages.featurize import (
 )
 from mmlspark_tpu.stages.value_indexer import ValueIndexer
 
-#: built-in learners (TPU-trained); mirrors the supported-learner dispatch
-#: at TrainClassifier.scala:45-52 minus trees (scope decision above)
+#: built-in learners; mirrors the supported-learner dispatch at
+#: TrainClassifier.scala:45-52
 LOGISTIC_REGRESSION = "logistic_regression"
 MLP_CLASSIFIER = "mlp"
+DECISION_TREE = "decision_tree"
+RANDOM_FOREST = "random_forest"
+GBT = "gbt"
+NAIVE_BAYES = "naive_bayes"
+
+#: learners featurized tree-style: small hash space, no one-hot
+#: (TrainClassifier.scala:107, Featurize.scala:13-19)
+_TREE_LEARNERS = (DECISION_TREE, RANDOM_FOREST, GBT)
 
 
 class TrainClassifier(Estimator, HasLabelCol):
@@ -68,7 +78,44 @@ class TrainClassifier(Estimator, HasLabelCol):
     hidden = Param("hidden layer sizes for the mlp learner", (128,))
     seed = Param("rng seed", 0, ptype=int)
 
+    # tree knobs (pass-through to the histogram learners)
+    max_depth = Param("tree depth", 5, ptype=int, validator=positive)
+    num_trees = Param("random-forest tree count", 20, ptype=int,
+                      validator=positive)
+    max_iter = Param("gbt boosting rounds", 20, ptype=int, validator=positive)
+
     def _make_learner(self, num_classes: int) -> Estimator:
+        from mmlspark_tpu.stages.classical import NaiveBayes
+        from mmlspark_tpu.stages.trees import (
+            DecisionTreeClassifier,
+            GBTClassifier,
+            RandomForestClassifier,
+        )
+
+        tree_common = dict(
+            features_col="features",
+            label_col="__label_idx__",
+            max_depth=self.max_depth,
+            seed=self.seed,
+        )
+        if self.model == DECISION_TREE:
+            return DecisionTreeClassifier(**tree_common)
+        if self.model == RANDOM_FOREST:
+            return RandomForestClassifier(
+                num_trees=self.num_trees, **tree_common
+            )
+        if self.model == GBT:
+            return GBTClassifier(
+                max_iter=self.max_iter,
+                step_size=self.learning_rate
+                if self.is_set("learning_rate")
+                else 0.1,
+                **tree_common,
+            )
+        if self.model == NAIVE_BAYES:
+            return NaiveBayes(
+                features_col="features", label_col="__label_idx__"
+            )
         if isinstance(self.model, Estimator):
             return self.model
         if self.model == LOGISTIC_REGRESSION:
@@ -100,17 +147,19 @@ class TrainClassifier(Estimator, HasLabelCol):
             )
         raise FriendlyError(
             f"unknown learner '{self.model}'; built-ins: "
-            f"{LOGISTIC_REGRESSION!r}, {MLP_CLASSIFIER!r}",
+            f"{LOGISTIC_REGRESSION!r}, {MLP_CLASSIFIER!r}, "
+            f"{DECISION_TREE!r}, {RANDOM_FOREST!r}, {GBT!r}, "
+            f"{NAIVE_BAYES!r}",
             self.uid,
         )
 
     def _num_features(self) -> int:
         if self.number_of_features is not None:
             return int(self.number_of_features)
-        # NN learners get the smaller hash space (Featurize.scala:13-19)
+        # tree/NN learners get the smaller hash space (Featurize.scala:13-19)
         return (
             TREE_NN_NUM_FEATURES
-            if self.model == MLP_CLASSIFIER
+            if self.model == MLP_CLASSIFIER or self.model in _TREE_LEARNERS
             else DEFAULT_NUM_FEATURES
         )
 
@@ -151,6 +200,12 @@ class TrainClassifier(Estimator, HasLabelCol):
         featurizer = Featurize(
             feature_columns={"features": feature_inputs},
             number_of_features=self._num_features(),
+            # trees split categoricals on the raw index — no OHE
+            # (TrainClassifier.scala:107)
+            one_hot_encode_categoricals=self.model not in _TREE_LEARNERS,
+            # naive Bayes needs raw non-negative counts (Spark MLlib
+            # requirement); z-scoring would sign-flip them
+            standardize=self.model != NAIVE_BAYES,
         ).fit(indexed)
         featurized = featurizer.transform(indexed)
 

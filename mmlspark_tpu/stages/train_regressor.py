@@ -29,6 +29,12 @@ from mmlspark_tpu.stages.featurize import (
 
 LINEAR_REGRESSION = "linear_regression"
 MLP_REGRESSOR = "mlp"
+DECISION_TREE = "decision_tree"
+RANDOM_FOREST = "random_forest"
+GBT = "gbt"
+
+#: learners featurized tree-style (small hash space, no OHE)
+_TREE_LEARNERS = (DECISION_TREE, RANDOM_FOREST, GBT)
 
 
 class TrainRegressor(Estimator, HasLabelCol):
@@ -43,8 +49,39 @@ class TrainRegressor(Estimator, HasLabelCol):
                       domain=("adam", "adamw", "sgd", "momentum"))
     hidden = Param("hidden sizes for the mlp learner", (128,))
     seed = Param("rng seed", 0, ptype=int)
+    # tree knobs (pass-through to the histogram learners)
+    max_depth = Param("tree depth", 5, ptype=int, validator=positive)
+    num_trees = Param("random-forest tree count", 20, ptype=int,
+                      validator=positive)
+    max_iter = Param("gbt boosting rounds", 20, ptype=int, validator=positive)
 
     def _make_learner(self) -> Estimator:
+        from mmlspark_tpu.stages.trees import (
+            DecisionTreeRegressor,
+            GBTRegressor,
+            RandomForestRegressor,
+        )
+
+        tree_common = dict(
+            features_col="features",
+            label_col="__label_double__",
+            max_depth=self.max_depth,
+            seed=self.seed,
+        )
+        if self.model == DECISION_TREE:
+            return DecisionTreeRegressor(**tree_common)
+        if self.model == RANDOM_FOREST:
+            return RandomForestRegressor(
+                num_trees=self.num_trees, **tree_common
+            )
+        if self.model == GBT:
+            return GBTRegressor(
+                max_iter=self.max_iter,
+                step_size=self.learning_rate
+                if self.is_set("learning_rate")
+                else 0.1,
+                **tree_common,
+            )
         if isinstance(self.model, Estimator):
             return self.model
         common = dict(
@@ -69,7 +106,8 @@ class TrainRegressor(Estimator, HasLabelCol):
             )
         raise FriendlyError(
             f"unknown learner '{self.model}'; built-ins: "
-            f"{LINEAR_REGRESSION!r}, {MLP_REGRESSOR!r}",
+            f"{LINEAR_REGRESSION!r}, {MLP_REGRESSOR!r}, {DECISION_TREE!r}, "
+            f"{RANDOM_FOREST!r}, {GBT!r}",
             self.uid,
         )
 
@@ -84,11 +122,13 @@ class TrainRegressor(Estimator, HasLabelCol):
         ]
         nf = self.number_of_features or (
             TREE_NN_NUM_FEATURES
-            if self.model == MLP_REGRESSOR
+            if self.model == MLP_REGRESSOR or self.model in _TREE_LEARNERS
             else DEFAULT_NUM_FEATURES
         )
         featurizer = Featurize(
-            feature_columns={"features": feature_inputs}, number_of_features=nf
+            feature_columns={"features": feature_inputs},
+            number_of_features=nf,
+            one_hot_encode_categoricals=self.model not in _TREE_LEARNERS,
         ).fit(ds)
         featurized = featurizer.transform(ds)
         fitted = self._make_learner().fit(featurized)

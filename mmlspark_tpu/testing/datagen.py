@@ -32,7 +32,9 @@ class DatasetOptions:
     extra: dict = field(default_factory=dict)
 
 
-def generate_dataset(options: DatasetOptions = DatasetOptions(), seed: int = 0) -> Dataset:
+def generate_dataset(
+    options: DatasetOptions = DatasetOptions(), seed: int = 0
+) -> Dataset:
     rng = np.random.default_rng(seed)
     n = options.num_rows
     cols: dict = {}
@@ -59,4 +61,56 @@ def generate_dataset(options: DatasetOptions = DatasetOptions(), seed: int = 0) 
             )
         else:
             cols["label"] = rng.normal(size=n)
+    return Dataset(cols)
+
+
+def make_census(n: int = 600, seed: int = 7, full_schema: bool = False) -> Dataset:
+    """Adult-Census-shaped synthetic table (notebook 101's input shape).
+
+    One generator shared by the e101 example, bench.py's TrainClassifier
+    epoch metric and tests, so the schema/label rule cannot drift between
+    them. ``full_schema`` adds the remaining census columns (14 features,
+    the real Adult schema width); the compact form keeps the 4 used by the
+    example.
+    """
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(18, 80, n)
+    hours = rng.uniform(10, 60, n)
+    edu = rng.choice(
+        ["hs", "college", "bachelors", "masters", "phd"]
+        if full_schema
+        else ["hs", "college", "phd"],
+        n,
+    )
+    occupation = rng.choice(["clerical", "exec", "tech", "service"], n)
+    score = (age - 40) / 20 + (hours - 35) / 15 + (edu == "phd") * 1.5
+    cols = {
+        "age": age,
+        "hours_per_week": hours,
+        "education": list(edu),
+        "occupation": list(occupation),
+    }
+    if full_schema:
+        edu_num = rng.integers(1, 16, n).astype(np.float64)
+        score = score + (edu_num - 8) / 6
+        cols.update({
+            "fnlwgt": rng.uniform(1e4, 1e6, n),
+            "education_num": edu_num,
+            "capital_gain": rng.exponential(500.0, n),
+            "capital_loss": rng.exponential(80.0, n),
+            "marital_status": list(
+                rng.choice(["married", "single", "divorced"], n)
+            ),
+            "relationship": list(
+                rng.choice(["husband", "wife", "own-child", "unmarried"], n)
+            ),
+            "race": list(rng.choice(["a", "b", "c", "d"], n)),
+            "sex": list(rng.choice(["m", "f"], n)),
+            "native_country": list(
+                rng.choice(["us", "mx", "ph", "de", "other"], n)
+            ),
+            "workclass": list(rng.choice(["private", "gov", "self"], n)),
+        })
+    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
+    cols["income"] = list(label)
     return Dataset(cols)

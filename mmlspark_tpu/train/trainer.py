@@ -20,7 +20,6 @@ TPU-native replacement, per BASELINE.json's north star:
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 

@@ -46,6 +46,14 @@ def _numeric_ds(seed=0):
     return Dataset({"features": x.astype(np.float32), "label": y})
 
 
+def _counts_ds(seed=0):
+    """Non-negative count-like features (NaiveBayes requirement)."""
+    rng = np.random.default_rng(seed)
+    x = rng.poisson(2.0, size=(32, 5)).astype(np.float32)
+    y = (x[:, 0] > 1).astype(np.int32)
+    return Dataset({"features": x, "label": y})
+
+
 def _image_ds(n=3):
     rng = np.random.default_rng(0)
     rows = [
@@ -108,9 +116,18 @@ def build_test_objects() -> dict[str, list[FuzzObject]]:
         SummarizeData,
         Timer,
     )
+    from mmlspark_tpu.stages.classical import NaiveBayes, OneVsRest
     from mmlspark_tpu.stages.text import TextFeaturizer
     from mmlspark_tpu.stages.train_classifier import TrainClassifier
     from mmlspark_tpu.stages.train_regressor import TrainRegressor
+    from mmlspark_tpu.stages.trees import (
+        DecisionTreeClassifier,
+        DecisionTreeRegressor,
+        GBTClassifier,
+        GBTRegressor,
+        RandomForestClassifier,
+        RandomForestRegressor,
+    )
     from mmlspark_tpu.stages.value_indexer import IndexToValue, ValueIndexer
 
     mixed = _mixed_ds()
@@ -132,6 +149,60 @@ def build_test_objects() -> dict[str, list[FuzzObject]]:
             )
         ],
         "TPUModel": [FuzzObject(_tiny_tpu_model(), numeric)],
+        "DecisionTreeClassifier": [
+            FuzzObject(
+                DecisionTreeClassifier(label_col="label", max_depth=3),
+                numeric,
+            )
+        ],
+        "RandomForestClassifier": [
+            FuzzObject(
+                RandomForestClassifier(
+                    label_col="label", max_depth=3, num_trees=3
+                ),
+                numeric,
+            )
+        ],
+        "GBTClassifier": [
+            FuzzObject(
+                GBTClassifier(label_col="label", max_depth=2, max_iter=2),
+                numeric,
+            )
+        ],
+        "DecisionTreeRegressor": [
+            FuzzObject(
+                DecisionTreeRegressor(label_col="label", max_depth=3),
+                numeric,
+            )
+        ],
+        "RandomForestRegressor": [
+            FuzzObject(
+                RandomForestRegressor(
+                    label_col="label", max_depth=3, num_trees=3
+                ),
+                numeric,
+            )
+        ],
+        "GBTRegressor": [
+            FuzzObject(
+                GBTRegressor(label_col="label", max_depth=2, max_iter=2),
+                numeric,
+            )
+        ],
+        "NaiveBayes": [
+            FuzzObject(NaiveBayes(label_col="label"), _counts_ds())
+        ],
+        "OneVsRest": [
+            FuzzObject(
+                OneVsRest(
+                    learner=DecisionTreeClassifier(
+                        label_col="label", max_depth=2
+                    ),
+                    label_col="label",
+                ),
+                numeric,
+            )
+        ],
         "DNNLearner": [
             FuzzObject(
                 DNNLearner(model_name="mlp", model_config={"hidden": (4,)},
@@ -269,6 +340,12 @@ DERIVED_MODEL_CLASSES = {
     "ClassBalancerModel": "ClassBalancer",
     "CleanMissingDataModel": "CleanMissingData",
     "BestModel": "FindBestModel",
+    "TreeClassifierModel": "DecisionTreeClassifier",
+    "GBTClassifierModel": "GBTClassifier",
+    "TreeRegressorModel": "DecisionTreeRegressor",
+    "GBTRegressorModel": "GBTRegressor",
+    "NaiveBayesModel": "NaiveBayes",
+    "OneVsRestModel": "OneVsRest",
 }
 
 #: stages that cannot be generically fuzzed, with the reason

@@ -138,3 +138,55 @@ def test_native_throughput_smoke(tmp_path):
     assert out.num_rows == 2000
     np.testing.assert_array_equal(out["features"], ds["features"])
     assert os.path.getsize(path) > 100_000
+
+
+def test_duplicate_field_last_occurrence_wins(tmp_path, monkeypatch):
+    """Native parser must match the Python dict semantics: a repeated
+    field name keeps the LAST occurrence."""
+    path = str(tmp_path / "dup.ctf")
+    with open(path, "w") as f:
+        f.write("|label 1 |features 0:1 |features 0:2\n")
+    native = read_ctf(path, feature_dim=3)
+    assert float(native["features"][0][0]) == 2.0
+    # and the Python fallback agrees
+    monkeypatch.setattr(
+        "mmlspark_tpu.data.ctf._read_ctf_native", lambda *a: None
+    )
+    py = read_ctf(path, feature_dim=3)
+    np.testing.assert_array_equal(
+        np.asarray(native["features"]), np.asarray(py["features"])
+    )
+
+
+def test_tab_in_field_name_not_a_delimiter(tmp_path):
+    """str.partition(' ') semantics: a tab does NOT end the field name, so
+    '|features\\t0:1' is an unknown field -> FriendlyError either path."""
+    path = str(tmp_path / "tab.ctf")
+    with open(path, "w") as f:
+        f.write("|label 1 |features\t0:1\n")
+    with pytest.raises(FriendlyError):
+        read_ctf(path, feature_dim=3)
+
+
+def test_ragged_rows_raise_friendly_error(tmp_path, monkeypatch):
+    """Python fallback wraps the np.stack width mismatch (ADVICE: was a
+    raw ValueError)."""
+    monkeypatch.setattr(
+        "mmlspark_tpu.data.ctf._read_ctf_native", lambda *a: None
+    )
+    path = str(tmp_path / "ragged.ctf")
+    with open(path, "w") as f:
+        f.write("|label 1 |features 1 2 3\n|label 1 |features 1 2\n")
+    with pytest.raises(FriendlyError, match="ragged"):
+        read_ctf(path)
+
+
+def test_bad_number_raises_friendly_error(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        "mmlspark_tpu.data.ctf._read_ctf_native", lambda *a: None
+    )
+    path = str(tmp_path / "badnum.ctf")
+    with open(path, "w") as f:
+        f.write("|label 1 |features 1 2:3 4\n")  # sparse token in dense field
+    with pytest.raises(FriendlyError, match="malformed"):
+        read_ctf(path)

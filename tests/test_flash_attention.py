@@ -81,3 +81,44 @@ def test_transformer_flash_impl(rng):
         np.asarray(dense_g.apply(variables, ids)),
         atol=2e-2, rtol=2e-2,
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_padded_seq(rng, causal):
+    """Backward at a sequence length that is NOT a block multiple: padded
+    rows/keys must contribute exactly zero gradient."""
+    q, k, v = _qkv(rng, s=11)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block=8) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+        )
+
+
+def test_flash_gradients_multiblock(rng):
+    """Grid accumulation across several q/k blocks in both bwd kernels."""
+    q, k, v = _qkv(rng, b=1, s=32)
+    w = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block=8) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v) * w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+        )

@@ -13,11 +13,10 @@ import numpy as np
 import pytest
 
 import mmlspark_tpu
-from mmlspark_tpu.core.stage import Estimator, Model, PipelineStage, Transformer
+from mmlspark_tpu.core.stage import Estimator, Model, PipelineStage
 from tests.fuzzing_objects import (
     DERIVED_MODEL_CLASSES,
     EXEMPTIONS,
-    FuzzObject,
     build_test_objects,
 )
 

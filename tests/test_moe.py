@@ -188,3 +188,37 @@ def test_trainer_moe_checkpoint_resume(tmp_path):
     assert steps and min(steps) >= 2  # resumed past epoch 1
     out = graph.apply(variables, jnp.asarray(ids[:2]))
     assert out.shape == (2, 4, 16)
+
+
+def test_moe_ffn_prime_token_count_keeps_group_size():
+    """Non-smooth token counts must pad to the group multiple, not
+    degenerate to 1-token groups (the old divisor-of-n scheme made
+    capacity vacuous for prime B*T)."""
+    rng = np.random.default_rng(3)
+    b, t, d, f, e = 1, 13, 8, 16, 3  # 13 tokens: prime
+    x = jnp.asarray(rng.normal(size=(b, t, d)), jnp.float32)
+    gate = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+    w_in = jnp.asarray(rng.normal(size=(e, d, f)) * 0.1, jnp.float32)
+    b_in = jnp.asarray(rng.normal(size=(e, f)) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.normal(size=(e, f, d)) * 0.1, jnp.float32)
+    b_out = jnp.asarray(rng.normal(size=(e, d)) * 0.1, jnp.float32)
+    out, aux = moe_ffn(x, gate, w_in, b_in, w_out, b_out,
+                       capacity_factor=float(e), group_size=8)
+    assert out.shape == (b, t, d)
+    assert np.isfinite(float(aux))
+    # ample capacity: must match the per-token dense computation exactly,
+    # including the final (padded) partial group
+    probs = np.asarray(router_probs(x.reshape(-1, d), gate))
+    chosen = probs.argmax(-1)
+    flat = np.asarray(x).reshape(-1, d)
+
+    def dense_expert(tok, c):
+        h = np.asarray(jax.nn.gelu(tok @ np.asarray(w_in[c])
+                                   + np.asarray(b_in[c])))
+        return h @ np.asarray(w_out[c]) + np.asarray(b_out[c])
+
+    want = np.stack(
+        [probs[i, c] * dense_expert(flat[i], c)
+         for i, c in enumerate(chosen)]
+    ).reshape(b, t, d)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3, atol=1e-4)

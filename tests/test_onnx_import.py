@@ -13,7 +13,7 @@ import pytest
 
 import jax.numpy as jnp
 
-from mmlspark_tpu.models.onnx_import import OnnxGraph, load_onnx
+from mmlspark_tpu.models.onnx_import import load_onnx
 
 
 # -- minimal protobuf writer -------------------------------------------------

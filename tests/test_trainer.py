@@ -4,7 +4,6 @@ ValidateCntkTrain.scala e2e tiny-epoch training)."""
 
 import jax
 import numpy as np
-import pytest
 
 from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.models import build_model

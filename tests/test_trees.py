@@ -1,0 +1,368 @@
+"""Histogram tree learner tests.
+
+Mirrors the reference's learner-coverage idea in
+train-classifier/src/test/scala/VerifyTrainClassifier.scala (every
+supported learner trained + scored on generated data) for the tree family.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.stages.classical import NaiveBayes, OneVsRest
+from mmlspark_tpu.stages.trees import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GBTClassifier,
+    GBTRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    bin_features,
+    quantile_edges,
+)
+
+
+def xor_ds(n=400, seed=0, noise=0.0):
+    """Linearly inseparable interaction with ASYMMETRIC thresholds.
+
+    Perfectly balanced XOR has exactly zero marginal gain for every
+    feature at every depth (conditioning on other features keeps the
+    symmetry), so greedy split choice there is pure tie-breaking noise —
+    the asymmetric cut points give the greedy search a real gradient
+    while keeping the problem unsolvable for linear models.
+    """
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = ((x[:, 0] > 0.45) ^ (x[:, 1] > -0.35)).astype(np.int32)
+    if noise:
+        flip = rng.random(n) < noise
+        y = np.where(flip, 1 - y, y)
+    return Dataset({"features": x, "label": y})
+
+
+def reg_ds(n=500, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (2.0 * x[:, 0] + np.sin(3.0 * x[:, 1])).astype(np.float32)
+    return Dataset({"features": x, "label": y})
+
+
+def r2(pred, y):
+    return 1.0 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+
+
+# -- binning ---------------------------------------------------------------
+
+
+def test_quantile_edges_constant_column_never_splits():
+    x = np.stack([np.ones(50), np.arange(50.0)], axis=1)
+    edges = quantile_edges(x, 8)
+    assert np.all(np.isinf(edges[0]))
+    bins = bin_features(x, edges)
+    assert np.all(bins[:, 0] == 0)
+    assert bins[:, 1].max() > 0
+
+
+def test_bin_features_monotone():
+    x = np.linspace(-3, 3, 100).reshape(-1, 1)
+    edges = quantile_edges(x, 16)
+    bins = bin_features(x, edges)[:, 0]
+    assert np.all(np.diff(bins) >= 0)
+    assert bins.max() <= 15
+
+
+# -- classification --------------------------------------------------------
+
+
+def test_decision_tree_solves_xor():
+    """XOR is the canonical linearly-inseparable problem: LR fails, a
+    depth-2+ tree nails it."""
+    ds = xor_ds()
+    model = DecisionTreeClassifier(label_col="label", max_depth=4).fit(ds)
+    scores = np.asarray(model.transform(ds)["scores"])
+    acc = (scores.argmax(1) == np.asarray(ds["label"])).mean()
+    assert acc > 0.95
+
+
+def test_tree_scores_are_log_probs():
+    ds = xor_ds()
+    model = DecisionTreeClassifier(label_col="label", max_depth=4).fit(ds)
+    scores = np.asarray(model.transform(ds)["scores"])
+    probs = np.exp(scores)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_max_depth_1_is_a_stump():
+    """A depth-1 tree cuts one feature once — it cannot express the
+    interaction, so it must trail the deep tree by a wide margin."""
+    ds = xor_ds()
+    y = np.asarray(ds["label"])
+    stump = DecisionTreeClassifier(label_col="label", max_depth=1).fit(ds)
+    deep = DecisionTreeClassifier(label_col="label", max_depth=4).fit(ds)
+    acc_stump = (
+        np.asarray(stump.transform(ds)["scores"]).argmax(1) == y
+    ).mean()
+    acc_deep = (
+        np.asarray(deep.transform(ds)["scores"]).argmax(1) == y
+    ).mean()
+    assert acc_stump < acc_deep - 0.15
+    # and the stump really is depth 1: exactly one real split
+    assert int((np.asarray(stump.threshs) < 32).sum()) == 1
+
+
+def test_min_instances_per_node_coarsens_tree():
+    ds = xor_ds(noise=0.1)
+    fine = DecisionTreeClassifier(label_col="label", max_depth=6).fit(ds)
+    coarse = DecisionTreeClassifier(
+        label_col="label", max_depth=6, min_instances_per_node=100
+    ).fit(ds)
+    # sentinel threshold == max_bins means "no split"; the constrained tree
+    # must refuse strictly more splits
+    n_splits_fine = int((np.asarray(fine.threshs) < 32).sum())
+    n_splits_coarse = int((np.asarray(coarse.threshs) < 32).sum())
+    assert n_splits_coarse < n_splits_fine
+
+
+def test_random_forest_beats_single_tree_on_noise():
+    train = xor_ds(seed=0, noise=0.25)
+    test = xor_ds(seed=9)
+    y = np.asarray(test["label"])
+    tree = DecisionTreeClassifier(label_col="label", max_depth=6).fit(train)
+    forest = RandomForestClassifier(
+        label_col="label", max_depth=6, num_trees=25, feature_subset="all"
+    ).fit(train)
+    acc_tree = (
+        np.asarray(tree.transform(test)["scores"]).argmax(1) == y
+    ).mean()
+    acc_forest = (
+        np.asarray(forest.transform(test)["scores"]).argmax(1) == y
+    ).mean()
+    assert acc_forest >= acc_tree - 0.02  # forest at least matches
+
+
+def test_random_forest_deterministic_by_seed():
+    ds = xor_ds()
+    a = RandomForestClassifier(label_col="label", num_trees=5, seed=3).fit(ds)
+    b = RandomForestClassifier(label_col="label", num_trees=5, seed=3).fit(ds)
+    np.testing.assert_array_equal(np.asarray(a.feats), np.asarray(b.feats))
+    np.testing.assert_array_equal(
+        np.asarray(a.values), np.asarray(b.values)
+    )
+
+
+def test_gbt_classifier_binary_and_multiclass():
+    ds = xor_ds()
+    model = GBTClassifier(label_col="label", max_iter=10, max_depth=3).fit(ds)
+    scores = np.asarray(model.transform(ds)["scores"])
+    assert scores.shape[1] == 2
+    acc = (scores.argmax(1) == np.asarray(ds["label"])).mean()
+    assert acc > 0.95
+
+    rng = np.random.default_rng(4)
+    n = 450
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y3 = (x[:, 0] > 0.4).astype(np.int32) + (x[:, 0] > -0.4).astype(np.int32)
+    ds3 = Dataset({"features": x, "label": y3})
+    m3 = GBTClassifier(label_col="label", max_iter=8, max_depth=3).fit(ds3)
+    s3 = np.asarray(m3.transform(ds3)["scores"])
+    assert s3.shape[1] == 3
+    assert (s3.argmax(1) == y3).mean() > 0.9
+
+
+def test_prime_row_count():
+    """Non-smooth sizes must not break device-side histogram shapes."""
+    ds = xor_ds(n=397)  # prime
+    model = GBTClassifier(label_col="label", max_iter=3, max_depth=3).fit(ds)
+    scores = np.asarray(model.transform(ds)["scores"])
+    assert scores.shape == (397, 2)
+
+
+# -- regression ------------------------------------------------------------
+
+
+def test_regression_tree_recovers_step_function():
+    x = np.linspace(-2, 2, 300).reshape(-1, 1).astype(np.float32)
+    y = np.where(x[:, 0] > 0.3, 5.0, -1.0).astype(np.float32)
+    ds = Dataset({"features": x, "label": y})
+    model = DecisionTreeRegressor(label_col="label", max_depth=3).fit(ds)
+    pred = np.asarray(model.transform(ds)["scores"])
+    # not 1.0: the quantile bin straddling the step cannot be separated
+    # (histogram-tree resolution limit), costing a few mixed rows
+    assert r2(pred, y) > 0.95
+
+
+def test_regression_leaf_is_label_mean():
+    """Depth-0-equivalent check: single split region means match leaves."""
+    x = np.array([[0.0]] * 10 + [[1.0]] * 10, np.float32)
+    y = np.array([2.0] * 10 + [6.0] * 10, np.float32)
+    ds = Dataset({"features": x, "label": y})
+    model = DecisionTreeRegressor(
+        label_col="label", max_depth=1, lambda_=0.0
+    ).fit(ds)
+    pred = np.asarray(model.transform(ds)["scores"])
+    np.testing.assert_allclose(pred[:10], 2.0, atol=1e-4)
+    np.testing.assert_allclose(pred[10:], 6.0, atol=1e-4)
+
+
+def test_gbt_regressor_beats_single_tree():
+    train, test = reg_ds(seed=1), reg_ds(seed=2)
+    y = np.asarray(test["label"])
+    tree = DecisionTreeRegressor(label_col="label", max_depth=3).fit(train)
+    gbt = GBTRegressor(label_col="label", max_iter=25, max_depth=3).fit(train)
+    r2_tree = r2(np.asarray(tree.transform(test)["scores"]), y)
+    r2_gbt = r2(np.asarray(gbt.transform(test)["scores"]), y)
+    assert r2_gbt > r2_tree
+
+
+def test_random_forest_regressor_runs():
+    ds = reg_ds()
+    model = RandomForestRegressor(
+        label_col="label", num_trees=8, max_depth=4, feature_subset="all"
+    ).fit(ds)
+    pred = np.asarray(model.transform(ds)["scores"])
+    assert r2(pred, np.asarray(ds["label"])) > 0.5
+
+
+# -- persistence -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "est",
+    [
+        DecisionTreeClassifier(label_col="label", max_depth=3),
+        GBTClassifier(label_col="label", max_iter=3, max_depth=2),
+        GBTRegressor(label_col="label", max_iter=3, max_depth=2),
+    ],
+    ids=["tree", "gbt_cls", "gbt_reg"],
+)
+def test_save_load_roundtrip(tmp_path, est):
+    ds = xor_ds(n=120)
+    model = est.fit(ds)
+    before = np.asarray(model.transform(ds)["scores"])
+    model.save(str(tmp_path / "m"))
+    loaded = PipelineStage.load(str(tmp_path / "m"))
+    after = np.asarray(loaded.transform(ds)["scores"])
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+# -- classical -------------------------------------------------------------
+
+
+def test_naive_bayes_posterior_and_rejects_negative():
+    rng = np.random.default_rng(0)
+    n = 300
+    x = rng.poisson(1.0, size=(n, 6)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.int32)
+    x[y == 1, 0] += 4
+    ds = Dataset({"features": x, "label": y})
+    model = NaiveBayes(label_col="label").fit(ds)
+    scores = np.asarray(model.transform(ds)["scores"])
+    assert (scores.argmax(1) == y).mean() > 0.9
+
+    bad = Dataset({"features": -x, "label": y})
+    with pytest.raises(Exception, match="non-negative"):
+        NaiveBayes(label_col="label").fit(bad)
+
+
+def test_one_vs_rest_multiclass():
+    rng = np.random.default_rng(2)
+    n = 300
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.int32) + (x[:, 0] > -0.5).astype(np.int32)
+    ds = Dataset({"features": x, "label": y})
+    ovr = OneVsRest(
+        learner=DecisionTreeClassifier(label_col="ignored", max_depth=3),
+        label_col="label",
+    ).fit(ds)
+    scores = np.asarray(ovr.transform(ds)["scores"])
+    assert scores.shape == (n, 3)
+    assert (scores.argmax(1) == y).mean() > 0.9
+
+
+# -- TrainClassifier / TrainRegressor dispatch -----------------------------
+
+
+def census_like(n=300, seed=7):
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(18, 80, n)
+    hours = rng.uniform(10, 60, n)
+    edu = rng.choice(["hs", "college", "phd"], n)
+    score = (age - 40) / 20 + (hours - 35) / 15 + (edu == "phd") * 1.5
+    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
+    return Dataset({
+        "age": age,
+        "hours": hours,
+        "education": list(edu),
+        "income": list(label),
+    })
+
+
+@pytest.mark.parametrize(
+    "learner", ["decision_tree", "random_forest", "gbt", "naive_bayes"]
+)
+def test_train_classifier_dispatch(learner):
+    from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
+    from mmlspark_tpu.stages.train_classifier import TrainClassifier
+
+    train, test = census_like(seed=7), census_like(n=150, seed=8)
+    model = TrainClassifier(label_col="income", model=learner).fit(train)
+    stats = ComputeModelStatistics().transform(model.transform(test))
+    acc = float(stats["accuracy"][0])
+    # dispatch sanity, not a leaderboard: axis-aligned trees approximate
+    # the diagonal boundary coarsely at n=300
+    floor = 0.6 if learner == "naive_bayes" else 0.7
+    assert acc > floor, f"{learner}: accuracy {acc}"
+
+
+@pytest.mark.parametrize("learner", ["decision_tree", "random_forest", "gbt"])
+def test_train_regressor_dispatch(learner):
+    from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
+    from mmlspark_tpu.stages.train_regressor import TrainRegressor
+
+    rng = np.random.default_rng(1)
+    n = 300
+    # several correlated informative columns so Spark's onethird
+    # feature-subset default (random forest) still sees signal per tree
+    xn = rng.normal(size=n)
+    x2 = xn + rng.normal(0, 0.3, n)
+    x3 = xn + rng.normal(0, 0.3, n)
+    cat = rng.choice(["a", "b", "c"], n)
+    y = xn * 2 + (cat == "b") * 3 + rng.normal(0, 0.1, n)
+    ds = Dataset({
+        "xn": xn, "x2": x2, "x3": x3, "cat": list(cat), "delay": y
+    })
+    model = TrainRegressor(label_col="delay", model=learner).fit(ds)
+    stats = ComputeModelStatistics().transform(model.transform(ds))
+    assert float(stats["R^2"][0]) > 0.5, learner
+
+
+def test_one_vs_rest_string_and_missing_labels():
+    """Generic-combinator contract: string labels index to levels, missing
+    labels drop (code-review finding: bare astype crashed on strings)."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(90, 4)).astype(np.float32)
+    y = np.where(x[:, 0] > 0.3, "hi", "lo").astype(object)
+    y[0] = None
+    ds = Dataset({"features": x, "lab": y})
+    ovr = OneVsRest(
+        learner=DecisionTreeClassifier(label_col="ignored", max_depth=3),
+        label_col="lab",
+    ).fit(ds)
+    assert ovr.levels == ["hi", "lo"]
+    scores = np.asarray(ovr.transform(ds)["scores"])
+    assert scores.shape == (90, 2)
+    pred = np.asarray(ovr.levels, object)[scores.argmax(1)]
+    assert (pred[1:] == y[1:]).mean() > 0.9
+
+
+def test_negative_labels_rejected():
+    """{-1,+1} encoding must error, not silently wrap class -1 onto k-1."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 3)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1).astype(np.int32)
+    ds = Dataset({"features": x, "label": y})
+    with pytest.raises(Exception, match=r"\[0, k\)"):
+        DecisionTreeClassifier(label_col="label").fit(ds)

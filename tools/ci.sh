@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-command CI gate: lint -> install check -> tests -> examples -> docgen.
+# The `runme` analog (reference runme:1-50 / sbt full-build at
+# src/project/build.scala:84-93: scalastyle -> compile -> test -> package
+# -> codegen). Usage:
+#   tools/ci.sh            # full run
+#   tools/ci.sh fast       # lint + tests only
+#   PROC_SHARD=1/3 tools/ci.sh   # shard the example suite (harness.py)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+step() { echo; echo "=== $1 ==="; }
+
+step "lint (scalastyle analog)"
+if command -v ruff >/dev/null 2>&1; then
+  ruff check .
+else
+  python tools/lint.py
+fi
+
+step "package import check"
+python -c "import mmlspark_tpu; print('mmlspark_tpu', 'stages:',
+len(mmlspark_tpu.all_stages()))"
+
+step "unit + integration tests (8-device CPU mesh via tests/conftest.py)"
+python -m pytest tests/ -q
+
+if [ "${1:-}" != "fast" ]; then
+  step "example suite (notebook-parity flows)"
+  python examples/harness.py
+
+  step "docgen"
+  python tools/docgen.py
+
+  step "bench smoke (one JSON line; real backend if available)"
+  python bench.py
+fi
+
+echo
+echo "CI green."

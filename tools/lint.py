@@ -1,0 +1,125 @@
+"""Self-contained static-analysis gate (the scalastyle analog).
+
+The reference enforces scalastyle + -Xfatal-warnings on every build
+(src/project/scalastyle.scala, build.scala:56-66,86). This environment has
+no third-party linter and no egress, so this is a stdlib-ast implementation
+of the checks that matter most for this codebase; tools/ci.sh prefers ruff
+(configured in pyproject.toml) when one is installed.
+
+Checks:
+  syntax        file parses (compile())
+  star-import   `from x import *` outside __init__.py
+  unused-import imported name never referenced (``# noqa: unused`` opts out)
+  bare-except   `except:` with no exception class
+  mutable-default mutable literal as a function default
+  tabs          tab indentation
+  trailing-ws   trailing whitespace
+  long-line     > MAX_LINE chars (URLs exempt)
+
+Exit code 0 = clean, 1 = findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 88
+ROOTS = ("mmlspark_tpu", "tests", "examples", "tools")
+TOP_FILES = ("bench.py", "__graft_entry__.py")
+
+
+class ImportChecker(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imported: dict[str, int] = {}  # name -> lineno
+        self.used: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name != "*":
+                self.imported[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def unused(self) -> dict[str, int]:
+        return {n: ln for n, ln in self.imported.items() if n not in self.used}
+
+
+def check_file(path: Path) -> list[str]:
+    problems: list[str] = []
+    text = path.read_text()
+    lines = text.splitlines()
+
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    is_init = path.name == "__init__.py"
+    ic = ImportChecker()
+    ic.visit(tree)
+    # names referenced in __all__ / docstring-driven re-exports count as used
+    for n, ln in ic.unused().items():
+        line = lines[ln - 1] if ln <= len(lines) else ""
+        if is_init or "noqa" in line or f'"{n}"' in text or f"'{n}'" in text:
+            continue
+        problems.append(f"{path}:{ln}: unused import '{n}'")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "*" for a in node.names
+        ):
+            if not is_init:
+                problems.append(f"{path}:{node.lineno}: star import")
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{path}:{node.lineno}: bare except")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{d.lineno}: mutable default argument"
+                    )
+
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip("\n")
+        if stripped.startswith("\t"):
+            problems.append(f"{path}:{i}: tab indentation")
+        if stripped != stripped.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if len(stripped) > MAX_LINE and "http" not in stripped:
+            problems.append(
+                f"{path}:{i}: line too long ({len(stripped)} > {MAX_LINE})"
+            )
+    return problems
+
+
+def main() -> int:
+    repo = Path(__file__).resolve().parent.parent
+    files: list[Path] = []
+    for root in ROOTS:
+        files.extend(sorted((repo / root).rglob("*.py")))
+    files.extend(repo / f for f in TOP_FILES)
+    problems: list[str] = []
+    for f in files:
+        if f.exists():
+            problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(problems)} problems")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
