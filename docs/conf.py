@@ -1,0 +1,10 @@
+# Sphinx configuration for environments that have sphinx installed
+# (this zero-egress build image does not — tools/docgen.py renders the
+# same generated .rst tree to static HTML instead; reference analog:
+# tools/pydocs assembling the codegen output).
+project = "mmlspark-tpu"
+author = "mmlspark-tpu developers"
+extensions: list[str] = []
+master_doc = "index"
+exclude_patterns = ["html"]
+html_theme = "alabaster"

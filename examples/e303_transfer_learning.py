@@ -1,23 +1,29 @@
 """Notebook-303/305 parity: transfer learning by DNN featurization.
 
 Reference flow (notebooks/samples/303 - Transfer Learning by DNN
-Featurization.ipynb): ImageFeaturizer with a pretrained CNN cut one layer
-from the top -> headless activations as features -> TrainClassifier on the
-features. Here the backbone is a ResNet-20 briefly pre-fitted on a related
-synthetic task (standing in for the model-zoo download), then cut and
-reused to featurize a new two-class image problem.
+Featurization.ipynb): ``ModelDownloader.downloadByName`` fetches a
+pretrained CNN from the model repo, ``ImageFeaturizer`` cuts it one layer
+from the top, and the headless activations feed ``TrainClassifier``
+(ModelDownloader.scala:230-236, ImageFeaturizer.scala:116-140). Same flow
+here: the backbone comes out of the committed model zoo
+(``models/zoo_repo``, published by ``tools/publish_zoo.py``) through the
+sha256-verified download path — not trained inline.
 """
+
+import os
+import tempfile
 
 import numpy as np
 
 from mmlspark_tpu.core.schema import ImageRow
+from mmlspark_tpu.core.stage import PipelineStage
 from mmlspark_tpu.data.dataset import Dataset
-from mmlspark_tpu.models import build_model
-from mmlspark_tpu.stages.dnn_model import TPUModel
+from mmlspark_tpu.models.zoo import ModelDownloader
 from mmlspark_tpu.stages.image import ImageFeaturizer
 from mmlspark_tpu.stages.prep import SelectColumns
 from mmlspark_tpu.stages.train_classifier import TrainClassifier
-from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "zoo_repo")
 
 
 def blob_images(n, seed, classes=2):
@@ -34,21 +40,14 @@ def blob_images(n, seed, classes=2):
 
 
 def main():
-    # "pretrained" backbone: quick fit so features carry signal
-    graph = build_model("resnet20_cifar10", width=8)
-    imgs, y = blob_images(256, seed=0)
-    x = np.stack(imgs).astype(np.float32) / 255.0
-    # enough steps for the BatchNorm running statistics to converge
-    # (eval mode uses them; momentum 0.9 needs ~50 steps)
-    trainer = SPMDTrainer(
-        graph, TrainConfig(epochs=15, batch_size=64, learning_rate=1e-2,
-                           log_every=20),
-    )
-    variables = trainer.train(x, y.astype(np.int32))
-    backbone = TPUModel.from_graph(
-        graph, variables, "resnet20_cifar10", model_config={"width": 8},
-        input_col="image", output_col="scores",
-    )
+    # pretrained backbone via the zoo download path (downloadByName with
+    # sha256 verify + local cache), like the notebook's
+    # d.downloadByName("ConvNet") cell
+    with tempfile.TemporaryDirectory() as local_repo:
+        downloader = ModelDownloader(local_repo, remote=ZOO)
+        schema = downloader.download_by_name("ResNet20_Blobs")
+        backbone = PipelineStage.load(downloader.local_path(schema))
+    assert schema.layer_names, "zoo schema must carry layer names for cuts"
 
     # featurize fresh train/test splits with the headless net (cut the
     # logits layer); scale matches the backbone's normalization (pix/255)
@@ -77,7 +76,8 @@ def main():
          == np.asarray(test_f["label"])).mean()
     )
     assert acc > 0.85, f"held-out accuracy {acc} too low"
-    print(f"OK {{'accuracy': {acc:.3f}, 'feature_dim': {feat_dim}}}")
+    print(f"OK {{'accuracy': {acc:.3f}, 'feature_dim': {feat_dim}, "
+          f"'model': '{schema.name}'}}")
 
 
 if __name__ == "__main__":

@@ -160,6 +160,7 @@ class _Attr:
     t: np.ndarray | None = None
     floats: tuple = ()
     ints: tuple = ()
+    strings: tuple = ()
 
 
 def _attributes(node_fs) -> dict[str, _Attr]:
@@ -172,6 +173,7 @@ def _attributes(node_fs) -> dict[str, _Attr]:
             s=_str(fs, 4),
             floats=tuple(_floats(fs, 7)),
             ints=tuple(_ints(fs, 8)),
+            strings=tuple(_strs(fs, 9)),
         )
         if fs.get(5):
             a.t = _tensor(_first(fs, 5))[1]
@@ -274,6 +276,159 @@ def _gemm(x, w, b, a: dict[str, _Attr]):
     if b is not None:
         y = y + beta * b
     return y
+
+
+def _opt_input(node, env, i):
+    """Optional ONNX input: None when absent or named '' (spec sentinel)."""
+    if i >= len(node.inputs) or not node.inputs[i]:
+        return None
+    return env[node.inputs[i]]
+
+
+#: scan directions per the RNN 'direction' attribute; reverse=True flips
+#: the sequence before and after the scan
+_RNN_DIRECTIONS = {
+    "": (False,),
+    "forward": (False,),
+    "reverse": (True,),
+    "bidirectional": (False, True),
+}
+
+_DEFAULT_ACTS = {
+    "LSTM": ("Sigmoid", "Tanh", "Tanh"),
+    "GRU": ("Sigmoid", "Tanh"),
+}
+
+
+def _rnn_parts(node, env, a, n_gates: int):
+    """Common LSTM/GRU input unpacking per the ONNX spec: X (S, B, I),
+    W (D, n_gates*H, I), R (D, n_gates*H, H), optional B (D, 2*n_gates*H).
+    Returns (x, w, r, wb, rb, hidden, reverses)."""
+    import jax.numpy as jnp
+
+    x, w, r = (_opt_input(node, env, i) for i in range(3))
+    hidden = a["hidden_size"].i if "hidden_size" in a else r.shape[-1]
+    direction = a["direction"].s if "direction" in a else ""
+    if direction not in _RNN_DIRECTIONS:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': unknown direction "
+            f"'{direction}'"
+        )
+    reverses = _RNN_DIRECTIONS[direction]
+    dirs = w.shape[0]
+    if dirs != len(reverses):
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': weight dirs {dirs} != "
+            f"direction '{direction or 'forward'}'"
+        )
+    acts = tuple(a["activations"].strings) if "activations" in a else ()
+    if acts and acts != _DEFAULT_ACTS[node.op] * dirs:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': only default activations "
+            f"{_DEFAULT_ACTS[node.op]} are supported, got {acts}"
+        )
+    b = _opt_input(node, env, 3)
+    if b is None:
+        wb = jnp.zeros((dirs, n_gates * hidden), x.dtype)
+        rb = jnp.zeros((dirs, n_gates * hidden), x.dtype)
+    else:
+        wb, rb = b[:, : n_gates * hidden], b[:, n_gates * hidden:]
+    if _opt_input(node, env, 4) is not None:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': per-row sequence_lens is not "
+            "supported — pad to a fixed length (data/feed.py bucketing)"
+        )
+    return x, w, r, wb, rb, hidden, reverses
+
+
+def _scan_direction(step, x, carry, reverse: bool):
+    import jax
+
+    xs = x[::-1] if reverse else x
+    carry, ys = jax.lax.scan(step, carry, xs)
+    return carry, (ys[::-1] if reverse else ys)
+
+
+def _onnx_lstm(node, env, a):
+    """ONNX LSTM (opset 7+ semantics, default activations; gate order
+    i, o, f, c). Outputs Y (S, D, B, H), Y_h (D, B, H), Y_c (D, B, H).
+    Implemented as lax.scan per direction — compiler-friendly recurrence
+    (the CNTK-v2 BiLSTM graph of notebook 304 maps onto this)."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    x, w, r, wb, rb, hidden, reverses = _rnn_parts(node, env, a, 4)
+    s, batch, _ = x.shape
+    dirs = len(reverses)
+    if _opt_input(node, env, 7) is not None:
+        raise FriendlyError(
+            f"ONNX LSTM '{node.name}': peephole weights (input P) are "
+            "not supported"
+        )
+
+    h0 = _opt_input(node, env, 5)
+    c0 = _opt_input(node, env, 6)
+    h0 = jnp.zeros((dirs, batch, hidden), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((dirs, batch, hidden), x.dtype) if c0 is None else c0
+
+    ys, hts, cts = [], [], []
+    for d, rev in enumerate(reverses):
+        wd, rd, wbd, rbd = w[d], r[d], wb[d], rb[d]
+
+        def step(carry, xt, wd=wd, rd=rd, wbd=wbd, rbd=rbd):
+            h, c = carry
+            g = xt @ wd.T + h @ rd.T + wbd + rbd
+            i_, o, f, cc = jnp.split(g, 4, axis=-1)
+            c_new = jnn.sigmoid(f) * c + jnn.sigmoid(i_) * jnp.tanh(cc)
+            h_new = jnn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (ht, ct), y = _scan_direction(step, x, (h0[d], c0[d]), reverse=rev)
+        ys.append(y)
+        hts.append(ht)
+        cts.append(ct)
+    y = jnp.stack(ys, axis=1)  # (S, D, B, H)
+    return [y, jnp.stack(hts), jnp.stack(cts)]
+
+
+def _onnx_gru(node, env, a):
+    """ONNX GRU (gate order z, r, h; ``linear_before_reset`` honored)."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    x, w, r, wb, rb, hidden, reverses = _rnn_parts(node, env, a, 3)
+    s, batch, _ = x.shape
+    dirs = len(reverses)
+    lbr = bool(a["linear_before_reset"].i) if "linear_before_reset" in a \
+        else False
+
+    h0 = _opt_input(node, env, 5)
+    h0 = jnp.zeros((dirs, batch, hidden), x.dtype) if h0 is None else h0
+
+    ys, hts = [], []
+    for d, rev in enumerate(reverses):
+        wd, rd, wbd, rbd = w[d], r[d], wb[d], rb[d]
+        wz, wr_, wh = jnp.split(wd, 3, axis=0)
+        rz, rr, rh = jnp.split(rd, 3, axis=0)
+        wbz, wbr, wbh = jnp.split(wbd, 3)
+        rbz, rbr, rbh = jnp.split(rbd, 3)
+
+        def step(carry, xt, wz=wz, wr_=wr_, wh=wh, rz=rz, rr=rr, rh=rh,
+                 wbz=wbz, wbr=wbr, wbh=wbh, rbz=rbz, rbr=rbr, rbh=rbh):
+            h = carry
+            z = jnn.sigmoid(xt @ wz.T + h @ rz.T + wbz + rbz)
+            rg = jnn.sigmoid(xt @ wr_.T + h @ rr.T + wbr + rbr)
+            if lbr:
+                hh = jnp.tanh(xt @ wh.T + rg * (h @ rh.T + rbh) + wbh)
+            else:
+                hh = jnp.tanh(xt @ wh.T + (rg * h) @ rh.T + wbh + rbh)
+            h_new = (1.0 - z) * hh + z * h
+            return h_new, h_new
+
+        ht, y = _scan_direction(step, x, h0[d], reverse=rev)
+        ys.append(y)
+        hts.append(ht)
+    return [jnp.stack(ys, axis=1), jnp.stack(hts)]
 
 
 def _static_ints(env, name, consts) -> list[int]:
@@ -384,9 +539,8 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
     op = node.op
 
     def inp(i, default=None):
-        if i >= len(node.inputs) or not node.inputs[i]:
-            return default
-        return env[node.inputs[i]]
+        v = _opt_input(node, env, i)
+        return default if v is None else v
 
     if op == "Conv":
         return [_conv(inp(0), inp(1), inp(2), a)]
@@ -489,6 +643,33 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
         for nm in node.inputs[1:]:
             out = out + env[nm]
         return [out]
+    if op == "Slice":
+        x = inp(0)
+        if len(node.inputs) > 1:  # opset 10+: starts/ends/axes/steps inputs
+            starts = _static_ints(env, node.inputs[1], consts)
+            ends = _static_ints(env, node.inputs[2], consts)
+            axes = (_static_ints(env, node.inputs[3], consts)
+                    if len(node.inputs) > 3 and node.inputs[3]
+                    else list(range(len(starts))))
+            steps = (_static_ints(env, node.inputs[4], consts)
+                     if len(node.inputs) > 4 and node.inputs[4]
+                     else [1] * len(starts))
+        else:  # opset 1: attributes
+            starts = list(a["starts"].ints)
+            ends = list(a["ends"].ints)
+            axes = (list(a["axes"].ints) if "axes" in a
+                    else list(range(len(starts))))
+            steps = [1] * len(starts)
+        idx = [slice(None)] * x.ndim
+        for st, en, ax, sp in zip(starts, ends, axes, steps):
+            # python slices already clamp INT_MAX-style sentinels and
+            # accept negative indices, matching ONNX Slice semantics
+            idx[ax] = slice(st, en, sp)
+        return [x[tuple(idx)]]
+    if op == "LSTM":
+        return _onnx_lstm(node, env, a)
+    if op == "GRU":
+        return _onnx_gru(node, env, a)
     raise FriendlyError(
         f"unsupported ONNX op '{op}' (node '{node.name}'); supported ops "
         "cover the CNN/MLP families — extend _apply_node for more"

@@ -253,3 +253,14 @@ def publish_model(
     with open(os.path.join(repo_root, MANIFEST), "w") as f:
         f.write("\n".join(metas) + "\n")
     return schema
+
+
+def default_downloader() -> ModelDownloader:
+    """Downloader wired from the app config namespace (core/config.py):
+    ``cache_dir``/models as the local repo, ``model_repo`` as the remote
+    (the reference's ``DefaultModelRepo`` role)."""
+    from mmlspark_tpu.core import config
+
+    local = os.path.join(config.get("cache_dir"), "models")
+    remote = config.get("model_repo") or None
+    return ModelDownloader(local, remote=remote)

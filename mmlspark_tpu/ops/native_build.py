@@ -84,8 +84,10 @@ _LIBS: dict[str, _NativeLib] = {
 
 
 def _compile(entry: _NativeLib) -> bool:
+    from mmlspark_tpu.core import config
+
     cmd = [
-        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        config.get("native_cc"), "-O2", "-fPIC", "-shared", "-std=c++17",
         entry.src, "-o", entry.so, *entry.link_flags,
     ]
     try:
@@ -103,12 +105,16 @@ def _compile(entry: _NativeLib) -> bool:
 def load_native(name: str) -> ctypes.CDLL | None:
     """Compile-if-needed and dlopen a registered native library; None if
     unavailable (callers fall back to pure Python)."""
+    from mmlspark_tpu.core import config
+
     entry = _LIBS[name]
     with entry.lock:
         if entry.lib is not None:
             return entry.lib
         if entry.build_failed:
             return None
+        if not config.get("native_build"):
+            return None  # Python fallbacks by configuration
         if not os.path.exists(entry.so) or os.path.getmtime(
             entry.so
         ) < os.path.getmtime(entry.src):

@@ -12,6 +12,7 @@ _STAGE_MODULES = [
     "value_indexer",
     "featurize",
     "text",
+    "word2vec",
     "trees",
     "classical",
     "train_classifier",

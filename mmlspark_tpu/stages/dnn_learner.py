@@ -49,6 +49,11 @@ class DNNLearner(Estimator, HasFeaturesCol, HasLabelCol):
     warmup_steps = Param("lr warmup steps", 0, ptype=int)
     seed = Param("rng seed", 0, ptype=int)
     shuffle = Param("shuffle each epoch", True, ptype=bool)
+    steps_per_dispatch = Param(
+        "optimizer steps chained per compiled call (exact; cuts host "
+        "dispatch overhead on high-latency links)", 1, ptype=int,
+        validator=positive,
+    )
     mesh_axes = Param("mesh axis name -> size; None = all-devices DP")
     checkpoint_dir = Param("orbax checkpoint directory (None = off)")
     checkpoint_every = Param("checkpoint every N steps (0 = end only)", 0,
@@ -68,6 +73,7 @@ class DNNLearner(Estimator, HasFeaturesCol, HasLabelCol):
             warmup_steps=self.warmup_steps,
             seed=self.seed,
             shuffle=self.shuffle,
+            steps_per_dispatch=self.steps_per_dispatch,
             mesh_axes=self.mesh_axes,
             checkpoint_dir=self.checkpoint_dir,
             checkpoint_every=self.checkpoint_every,

@@ -77,6 +77,10 @@ class TrainClassifier(Estimator, HasLabelCol):
     learning_rate = Param("learning rate", 1e-2, ptype=float)
     hidden = Param("hidden layer sizes for the mlp learner", (128,))
     seed = Param("rng seed", 0, ptype=int)
+    steps_per_dispatch = Param(
+        "optimizer steps per compiled call (NN learners)", 1, ptype=int,
+        validator=positive,
+    )
 
     # tree knobs (pass-through to the histogram learners)
     max_depth = Param("tree depth", 5, ptype=int, validator=positive)
@@ -127,6 +131,7 @@ class TrainClassifier(Estimator, HasLabelCol):
                 batch_size=self.batch_size,
                 learning_rate=self.learning_rate,
                 seed=self.seed,
+                steps_per_dispatch=self.steps_per_dispatch,
                 features_col="features",
                 label_col="__label_idx__",
             )
@@ -142,6 +147,7 @@ class TrainClassifier(Estimator, HasLabelCol):
                 batch_size=self.batch_size,
                 learning_rate=self.learning_rate,
                 seed=self.seed,
+                steps_per_dispatch=self.steps_per_dispatch,
                 features_col="features",
                 label_col="__label_idx__",
             )

@@ -49,6 +49,10 @@ class TrainRegressor(Estimator, HasLabelCol):
                       domain=("adam", "adamw", "sgd", "momentum"))
     hidden = Param("hidden sizes for the mlp learner", (128,))
     seed = Param("rng seed", 0, ptype=int)
+    steps_per_dispatch = Param(
+        "optimizer steps per compiled call (NN learners)", 1, ptype=int,
+        validator=positive,
+    )
     # tree knobs (pass-through to the histogram learners)
     max_depth = Param("tree depth", 5, ptype=int, validator=positive)
     num_trees = Param("random-forest tree count", 20, ptype=int,
@@ -91,6 +95,7 @@ class TrainRegressor(Estimator, HasLabelCol):
             learning_rate=self.learning_rate,
             optimizer=self.optimizer,
             seed=self.seed,
+            steps_per_dispatch=self.steps_per_dispatch,
             features_col="features",
             label_col="__label_double__",
         )

@@ -55,6 +55,13 @@ class TrainConfig:
     seed: int = 0
     log_every: int = 50
     shuffle: bool = True
+    # chain K optimizer steps inside ONE compiled call (lax.scan over K
+    # stacked batches): cuts per-step host dispatch to 1/K — decisive on
+    # high-latency links (TPU behind a relay). Semantics are exact: every
+    # batch is still one optimizer step; epoch tails that don't fill a
+    # chunk run through the single-step program. Ignored (forced 1) under
+    # tensor-parallel param_rules.
+    steps_per_dispatch: int = 1
     # weight on sown auxiliary losses (e.g. MoE load-balance, models/moe.py)
     moe_aux_weight: float = 1e-2
     # mesh: axis name -> size; None = all devices on the data axis
@@ -309,6 +316,35 @@ class SPMDTrainer:
             rest = jax.device_put(rest, rep_sh)
             opt_state = jax.device_put(opt_state, rep_sh)
 
+        k_steps = max(int(cfg.steps_per_dispatch), 1)
+        if cfg.param_rules:
+            k_steps = 1  # TP branch compiles without explicit shardings
+        chunk_jitted = chunk_sh = None
+        if k_steps > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def chunk_fn(params, rest, opt_state, bxs, bys, bms):
+                def body(carry, xs):
+                    p, r, o = carry
+                    p, r, o, loss = step_fn(p, r, o, *xs)
+                    return (p, r, o), loss
+
+                (params, rest, opt_state), losses = jax.lax.scan(
+                    body, (params, rest, opt_state), (bxs, bys, bms)
+                )
+                return params, rest, opt_state, losses[-1]
+
+            # batch dim is axis 1 of the (K, batch, ...) stacks
+            chunk_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+            chunk_jitted = jax.jit(
+                chunk_fn,
+                in_shardings=(
+                    rep_sh, rep_sh, rep_sh, chunk_sh, chunk_sh, chunk_sh,
+                ),
+                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh),
+                donate_argnums=(0, 1, 2),
+            )
+
         from mmlspark_tpu.data.feed import MASK_COL, batch_iterator
         from mmlspark_tpu.data.dataset import Dataset
 
@@ -330,32 +366,72 @@ class SPMDTrainer:
                 import itertools
 
                 it = itertools.islice(it, skip_in_first, None)
-            for b in it:
-                bx = jax.device_put(jnp.asarray(b["x"]), data_sh)
-                by = jax.device_put(jnp.asarray(b["y"]), data_sh)
-                bm = jax.device_put(jnp.asarray(b[MASK_COL]), data_sh)
-                params, rest, opt_state, loss = jitted(
-                    params, rest, opt_state, bx, by, bm
-                )
-                if step % max(cfg.log_every, 1) == 0:
+            def grouped(batches):
+                buf: list = []
+                for b in batches:
+                    buf.append(b)
+                    if len(buf) == k_steps:
+                        yield buf
+                        buf = []
+                if buf:
+                    yield buf  # epoch tail; runs through the 1-step path
+
+            log_every = max(cfg.log_every, 1)
+            for group in grouped(it):
+                if k_steps > 1 and len(group) == k_steps:
+                    stacks = (
+                        jax.device_put(
+                            jnp.stack([jnp.asarray(b[c]) for b in group]),
+                            chunk_sh,
+                        )
+                        for c in ("x", "y", MASK_COL)
+                    )
+                    params, rest, opt_state, loss = chunk_jitted(
+                        params, rest, opt_state, *stacks
+                    )
+                    n_done = len(group)
+                else:
+                    for b in group:
+                        bx = jax.device_put(jnp.asarray(b["x"]), data_sh)
+                        by = jax.device_put(jnp.asarray(b["y"]), data_sh)
+                        bm = jax.device_put(
+                            jnp.asarray(b[MASK_COL]), data_sh
+                        )
+                        params, rest, opt_state, loss = jitted(
+                            params, rest, opt_state, bx, by, bm
+                        )
+                    n_done = len(group)
+                # log once if any step in [step, step+n) hits the cadence;
+                # the fetched loss is the group's LAST step's, so label it
+                # with that step (chunking coarsens cadence, never lies)
+                next_log = step + (-step) % log_every
+                step += n_done
+                if next_log < step:
                     loss_val = float(loss)
                     self.history.append(
-                        {"step": step, "epoch": epoch, "loss": loss_val}
+                        {"step": step - 1, "epoch": epoch, "loss": loss_val}
                     )
-                    _log.info("step %d epoch %d loss %.5f", step, epoch, loss_val)
+                    _log.info("step %d epoch %d loss %.5f", step - 1, epoch,
+                              loss_val)
                 if (
                     mngr is not None
                     and cfg.checkpoint_every
-                    and mngr.should_save(step)
+                    # any step of the finished group on the save cadence
+                    # triggers a save of the current (group-end) state —
+                    # with chunked dispatch the exact cadence step has no
+                    # materialized state of its own
+                    and any(
+                        mngr.should_save(s)
+                        for s in range(step - n_done, step)
+                    )
                 ):
                     # gate BEFORE building args: _ckpt_args device_gets the
                     # whole (possibly TP-sharded) state, which would stall
                     # async dispatch on every non-checkpoint step
                     mngr.save(
-                        step,
+                        step - 1,
                         args=_ckpt_args(params, rest, opt_state),
                     )
-                step += 1
             if eval_fn is not None:
                 variables = _merge_variables(
                     jax.device_get(params), jax.device_get(rest)

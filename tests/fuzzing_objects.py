@@ -46,6 +46,13 @@ def _numeric_ds(seed=0):
     return Dataset({"features": x.astype(np.float32), "label": y})
 
 
+def _text_ds(seed=0):
+    rng = np.random.default_rng(seed)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    docs = [" ".join(rng.choice(words, 6)) for _ in range(16)]
+    return Dataset({"text": docs})
+
+
 def _counts_ds(seed=0):
     """Non-negative count-like features (NaiveBayes requirement)."""
     rng = np.random.default_rng(seed)
@@ -129,6 +136,7 @@ def build_test_objects() -> dict[str, list[FuzzObject]]:
         RandomForestRegressor,
     )
     from mmlspark_tpu.stages.value_indexer import IndexToValue, ValueIndexer
+    from mmlspark_tpu.stages.word2vec import Word2Vec
 
     mixed = _mixed_ds()
     numeric = _numeric_ds()
@@ -149,6 +157,13 @@ def build_test_objects() -> dict[str, list[FuzzObject]]:
             )
         ],
         "TPUModel": [FuzzObject(_tiny_tpu_model(), numeric)],
+        "Word2Vec": [
+            FuzzObject(
+                Word2Vec(input_col="text", vector_size=4, window=2,
+                         min_count=1, epochs=1),
+                _text_ds(),
+            )
+        ],
         "DecisionTreeClassifier": [
             FuzzObject(
                 DecisionTreeClassifier(label_col="label", max_depth=3),
@@ -345,6 +360,7 @@ DERIVED_MODEL_CLASSES = {
     "TreeRegressorModel": "DecisionTreeRegressor",
     "GBTRegressorModel": "GBTRegressor",
     "NaiveBayesModel": "NaiveBayes",
+    "Word2VecModel": "Word2Vec",
     "OneVsRestModel": "OneVsRest",
 }
 

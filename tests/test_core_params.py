@@ -83,3 +83,48 @@ def test_uids_unique_and_prefixed():
     a, b = _Toy(), _Toy()
     assert a.uid != b.uid
     assert a.uid.startswith("_Toy")
+
+
+# -- app config namespace (core/config.py, MMLConfig analog) ----------------
+
+
+def test_config_defaults_and_env_override(monkeypatch):
+    from mmlspark_tpu.core import config
+
+    config.reset()
+    try:
+        assert config.get("native_cc") == "c++"
+        assert config.get("native_build") is True
+        monkeypatch.setenv("MMLSPARK_TPU_NATIVE_BUILD", "false")
+        monkeypatch.setenv("MMLSPARK_TPU_NATIVE_CC", "g++-12")
+        config.reset()
+        assert config.get("native_build") is False
+        assert config.get("native_cc") == "g++-12"
+    finally:
+        config.reset()
+
+
+def test_config_file_layer_and_unknown_keys(tmp_path, monkeypatch):
+    import json
+
+    from mmlspark_tpu.core import config
+    from mmlspark_tpu.core.exceptions import FriendlyError
+
+    path = tmp_path / "conf.json"
+    path.write_text(json.dumps({"log_level": "DEBUG"}))
+    monkeypatch.setenv("MMLSPARK_TPU_CONFIG", str(path))
+    config.reset()
+    try:
+        assert config.get("log_level") == "DEBUG"
+        info = config.explain()
+        assert info["log_level"]["value"] == "DEBUG"
+        assert "doc" in info["cache_dir"]
+        with pytest.raises(FriendlyError):
+            config.get("nope")
+        path.write_text(json.dumps({"not_a_key": 1}))
+        config.reset()
+        with pytest.raises(FriendlyError, match="unknown config key"):
+            config.get("log_level")
+    finally:
+        monkeypatch.delenv("MMLSPARK_TPU_CONFIG")
+        config.reset()

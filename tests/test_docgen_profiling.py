@@ -70,3 +70,22 @@ def test_timer_profile_dir(tmp_path):
     assert out.columns == ["a"]
     assert timer.records and timer.records[0]["seconds"] >= 0
     assert os.path.isdir(out_dir) and os.listdir(out_dir)
+
+
+def test_docgen_html_rendering(tmp_path):
+    """The static HTML assembly (sphinx stand-in): tables become real
+    <table> markup and toctree entries become links."""
+    import tools.docgen as docgen
+
+    rst_dir = str(tmp_path / "api")
+    html_dir = str(tmp_path / "html")
+    docgen.generate(rst_dir)
+    written = docgen.render_html(rst_dir, html_dir)
+    assert len(written) > 10
+    with open(os.path.join(html_dir, "dnn_learner.html")) as f:
+        page = f.read()
+    assert "<table><tr><th>param</th>" in page
+    assert "batch_size" in page and "<h2>DNNLearner</h2>" in page
+    with open(os.path.join(html_dir, "index.html")) as f:
+        index = f.read()
+    assert "<a href='dnn_learner.html'>" in index

@@ -1,0 +1,114 @@
+"""Behavior parity against committed real-format fixtures.
+
+Everything else in the suite runs on in-process synthetic data; these
+tests pin end-to-end behavior against actual serialized artifacts
+(real PNG/JPEG bytes through the native decode op, zip traversal, a
+census-schema CSV) with RECORDED accuracy expectations — the analog of
+the reference notebooks' known dataset results.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.readers import read_csv, read_images
+from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
+from mmlspark_tpu.stages.image import ImageFeaturizer, UnrollImage
+from mmlspark_tpu.stages.train_classifier import TrainClassifier
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+IMAGES = os.path.join(FIXTURES, "images")
+CENSUS = os.path.join(FIXTURES, "census.csv")
+
+
+def _labels_from_paths(ds):
+    return [os.path.basename(p).split("_")[0] for p in
+            (r.path for r in ds["image"])]
+
+
+def test_read_images_decodes_real_files():
+    ds = read_images(IMAGES)
+    assert ds.num_rows == 24  # every png/jpg decodes, none dropped
+    rows = list(ds["image"])
+    for r in rows:
+        assert r.data.shape == (32, 32, 3)
+        assert r.data.dtype == np.uint8
+    # PNG is lossless: the bright half must be bright in BGR bytes too
+    top = next(r for r in rows if "top_" in os.path.basename(r.path)
+               and r.path.endswith(".png"))
+    assert top.data[:16].mean() > top.data[16:].mean() + 60
+
+
+def test_zip_traversal_reads_archived_images():
+    ds = read_images(os.path.join(FIXTURES, "images_extra.zip"))
+    assert ds.num_rows == 6
+    assert all("zipped/" in r.path for r in ds["image"])
+
+
+def test_image_classification_from_files_recorded_accuracy():
+    """Files -> decode -> unroll -> TrainClassifier: the two visual
+    classes are trivially separable; recorded expectation = 100% on the
+    training set (24 images, pixel-level signal)."""
+    ds = read_images(IMAGES)
+    labels = _labels_from_paths(ds)
+    ds = ds.with_column("label", labels)
+    unrolled = UnrollImage().transform(ds).select("unrolled", "label")
+    model = TrainClassifier(
+        label_col="label", epochs=30, learning_rate=5e-2
+    ).fit(unrolled)
+    scored = model.transform(unrolled)
+    acc = (np.asarray(scored["scored_labels"]) == np.asarray(labels)).mean()
+    assert acc == 1.0, acc
+
+
+def test_census_csv_recorded_accuracy():
+    """CSV slice -> TrainClassifier(LR): recorded expectation from the
+    generator's noise level (sigma 0.4 on the margin) is ~0.87-0.93
+    held-out; assert the recorded band so silent behavior drift fails."""
+    ds = read_csv(CENSUS)
+    assert set(ds.columns) == {
+        "age", "hours_per_week", "education", "occupation", "income"
+    }
+    assert ds.num_rows == 400
+    train, test = ds.filter(np.arange(400) < 300), ds.filter(
+        np.arange(400) >= 300
+    )
+    model = TrainClassifier(
+        label_col="income", epochs=25, learning_rate=5e-2, seed=0
+    ).fit(train)
+    stats = ComputeModelStatistics().transform(model.transform(test))
+    acc = float(stats["accuracy"][0])
+    auc = float(stats["AUC"][0])
+    assert 0.85 <= acc <= 1.0, acc
+    assert auc > 0.93, auc
+
+
+@pytest.mark.parametrize("ext", ["png", "jpg"])
+def test_featurizer_flow_on_files(ext):
+    """ImageFeaturizer over real decoded files (notebook-302 shape)."""
+    from mmlspark_tpu.stages.dnn_model import TPUModel
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+
+    ds = read_images(IMAGES)
+    keep = [i for i, r in enumerate(ds["image"])
+            if r.path.endswith("." + ext)]
+    ds = ds.filter(np.isin(np.arange(ds.num_rows), keep))
+    g = build_model("resnet20_cifar10", width=8)
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    backbone = TPUModel.from_graph(
+        g, v, "resnet20_cifar10", model_config={"width": 8},
+        input_col="image",
+    )
+    out = ImageFeaturizer(
+        model=backbone, cut_output_layers=1, scale=1 / 255.0
+    ).transform(ds)
+    feats = np.asarray(out["features"].tolist())
+    assert feats.shape[0] == len(keep) and feats.shape[1] > 1
+    assert np.isfinite(feats).all()

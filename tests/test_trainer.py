@@ -149,3 +149,29 @@ def test_mid_epoch_resume_continues_data_position(tmp_path):
     t_resumed.train(x, y)
     assert t_resumed.history[-1]["step"] == total_steps_full
     assert t_resumed.history[0]["step"] == 3  # continued, no replay
+
+
+def test_steps_per_dispatch_exactness():
+    """Chaining K steps in one lax.scan dispatch is an execution strategy,
+    not a semantic change: final params must match the 1-step path,
+    including an epoch tail that doesn't fill a chunk (10 steps, K=4)."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(80, 6)).astype(np.float32)  # 10 batches of 8
+    y = (x[:, 0] > 0).astype(np.int32)
+    graph = build_model("mlp", num_outputs=2, hidden=(8,))
+
+    def run(k):
+        tr = SPMDTrainer(
+            graph,
+            TrainConfig(epochs=2, batch_size=8, learning_rate=1e-2,
+                        steps_per_dispatch=k, seed=3),
+        )
+        return tr.train(x, y)
+
+    v1, v4 = run(1), run(4)
+    flat1 = jax.tree_util.tree_leaves(v1)
+    flat4 = jax.tree_util.tree_leaves(v4)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )

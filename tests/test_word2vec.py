@@ -1,0 +1,103 @@
+"""Word2Vec skip-gram featurizer (notebook-202 capability)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.stages.word2vec import Word2Vec
+
+
+def topic_ds(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    topics = {
+        "cook": "recipe kitchen oven bake flour sugar taste meal".split(),
+        "scifi": "space alien ship galaxy laser robot planet star".split(),
+    }
+    docs, labels = [], []
+    for _ in range(n):
+        k = rng.choice(list(topics))
+        words = list(rng.choice(topics[k], 10)) + list(
+            rng.choice(["the", "a", "and"], 3)
+        )
+        rng.shuffle(words)
+        docs.append(" ".join(words))
+        labels.append(k)
+    return Dataset({"text": docs, "label": labels})
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    ds = topic_ds()
+    model = Word2Vec(
+        input_col="text", vector_size=16, window=4, min_count=2, epochs=3
+    ).fit(ds)
+    return ds, model
+
+
+def test_vocab_and_vector_shapes(fitted):
+    _, model = fitted
+    vecs = np.asarray(model.vectors)
+    assert vecs.shape == (len(model.vocabulary), 16)
+
+
+def test_embeddings_cluster_by_topic(fitted):
+    """Words from the same topic must be nearer than cross-topic words —
+    the property the notebook's findSynonyms cell demonstrates."""
+    _, model = fitted
+    syns = [w for w, _ in model.find_synonyms("oven", 4)]
+    cook = set("recipe kitchen bake flour sugar taste meal".split())
+    assert sum(w in cook for w in syns) >= 3, syns
+
+
+def test_transform_averages_word_vectors(fitted):
+    _, model = fitted
+    vecs = np.asarray(model.vectors, np.float64)
+    idx = {t: i for i, t in enumerate(model.vocabulary)}
+    ds = Dataset({"text": ["oven bake flour"]})
+    out = np.asarray(model.transform(ds)["features"])[0]
+    want = vecs[[idx["oven"], idx["bake"], idx["flour"]]].mean(axis=0)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_unknown_and_empty_docs_are_zero_vectors(fitted):
+    _, model = fitted
+    ds = Dataset({"text": ["zzz qqq unknownwords", ""]})
+    out = np.asarray(model.transform(ds)["features"])
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_pretokenized_input(fitted):
+    _, model = fitted
+    as_text = np.asarray(model.transform(
+        Dataset({"text": ["oven bake"]}))["features"])
+    as_tokens = np.asarray(model.transform(
+        Dataset({"text": [["oven", "bake"]]}))["features"])
+    np.testing.assert_allclose(as_text, as_tokens)
+
+
+def test_min_count_filters_vocab():
+    ds = Dataset({"text": ["rare word once", "common common common word"]})
+    model = Word2Vec(
+        input_col="text", vector_size=4, window=2, min_count=2, epochs=1
+    ).fit(ds)
+    assert "rare" not in model.vocabulary
+    assert "common" in model.vocabulary
+
+
+def test_find_synonyms_unknown_word_errors(fitted):
+    _, model = fitted
+    with pytest.raises(FriendlyError):
+        model.find_synonyms("notaword", 3)
+
+
+def test_save_load_roundtrip(fitted, tmp_path):
+    ds, model = fitted
+    before = np.asarray(model.transform(ds)["features"])
+    model.save(str(tmp_path / "w2v"))
+    loaded = PipelineStage.load(str(tmp_path / "w2v"))
+    after = np.asarray(loaded.transform(ds)["features"])
+    np.testing.assert_allclose(before, after)

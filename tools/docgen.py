@@ -119,7 +119,118 @@ def generate(out_dir: str) -> list[str]:
     return written
 
 
+# -- HTML assembly -----------------------------------------------------------
+#
+# The reference assembles its generated .rst with a sphinx build
+# (tools/pydocs). This image has neither sphinx nor docutils and no
+# egress, so render_html() converts the exact .rst subset generate()
+# emits (titles, sections, paragraphs, literals, simple-format tables)
+# into a static HTML site; docs/conf.py remains for sphinx-equipped
+# environments.
+
+_CSS = """body{font-family:sans-serif;max-width:60em;margin:2em auto;
+padding:0 1em;color:#222}table{border-collapse:collapse;margin:1em 0}
+td,th{border:1px solid #bbb;padding:.3em .6em;text-align:left;
+font-size:.9em}th{background:#eee}code{background:#f4f4f4;
+padding:0 .2em}h1{border-bottom:2px solid #444}h2{color:#334}"""
+
+
+def _esc(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _inline(s: str) -> str:
+    import re
+
+    return re.sub(r"``([^`]*)``", r"<code>\1</code>", _esc(s))
+
+
+def _rst_to_html(text: str, title: str, pages: set[str] = frozenset()) -> str:
+    lines = text.splitlines()
+    out = [f"<!doctype html><html><head><meta charset='utf-8'>"
+           f"<title>{_esc(title)}</title><style>{_CSS}</style></head><body>"]
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        nxt = lines[i + 1] if i + 1 < len(lines) else ""
+        if nxt and set(nxt.strip()) == {"="} and len(nxt) >= len(line) > 0:
+            out.append(f"<h1>{_inline(line)}</h1>")
+            i += 2
+        elif nxt and set(nxt.strip()) == {"-"} and len(nxt) >= len(line) > 0:
+            out.append(f"<h2>{_inline(line)}</h2>")
+            i += 2
+        elif (line.strip() and set(line.strip()) <= {"=", " "}
+              and " " in line.strip()):
+            # simple-format table: border, header, border, rows..., border
+            cols, start = [], 0
+            for seg in line.split():
+                begin = line.index(seg, start)
+                cols.append((begin, begin + len(seg)))
+                start = begin + len(seg)
+            cols[-1] = (cols[-1][0], 10 ** 6)
+
+            def cells(row):
+                return [row[a:b].strip() for a, b in cols]
+
+            header = cells(lines[i + 1])
+            out.append("<table><tr>" + "".join(
+                f"<th>{_inline(c)}</th>" for c in header) + "</tr>")
+            j = i + 3
+            def _is_border(row):
+                st = row.strip()
+                return st and set(st) <= {"=", " "}
+
+            while j < len(lines) and not _is_border(lines[j]):
+                out.append("<tr>" + "".join(
+                    f"<td>{_inline(c)}</td>" for c in cells(lines[j])
+                ) + "</tr>")
+                j += 1
+            out.append("</table>")
+            i = j + 1
+        elif line.startswith(".. toctree::"):
+            i += 1  # directive; options/entries handled as links below
+        elif line.strip().startswith(":"):
+            i += 1  # directive option
+        elif line.startswith("   ") and line.strip() in pages:
+            name = line.strip()
+            out.append(f"<p><a href='{name}.html'>{_esc(name)}</a></p>")
+            i += 1
+        elif line.startswith("   ") and line.strip():
+            out.append(f"<p style='margin-left:2em'>{_inline(line)}</p>")
+            i += 1
+        elif line.strip():
+            out.append(f"<p>{_inline(line)}</p>")
+            i += 1
+        else:
+            i += 1
+    out.append("</body></html>")
+    return "\n".join(out)
+
+
+def render_html(rst_dir: str, out_dir: str) -> list[str]:
+    """Static HTML site from the generated .rst tree."""
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    pages = {os.path.splitext(f)[0] for f in os.listdir(rst_dir)
+             if f.endswith(".rst")}
+    for fname in sorted(os.listdir(rst_dir)):
+        if not fname.endswith(".rst"):
+            continue
+        base = os.path.splitext(fname)[0]
+        with open(os.path.join(rst_dir, fname)) as f:
+            html = _rst_to_html(f.read(), base, pages)
+        path = os.path.join(out_dir, f"{base}.html")
+        with open(path, "w") as f:
+            f.write(html)
+        written.append(path)
+    return written
+
+
 if __name__ == "__main__":
     out = sys.argv[1] if len(sys.argv) > 1 else "docs/api"
     paths = generate(out)
-    print(f"wrote {len(paths)} files under {out}")
+    html = render_html(out, os.path.join(os.path.dirname(out) or ".",
+                                         "html"))
+    print(f"wrote {len(paths)} rst + {len(html)} html files under "
+          f"{os.path.dirname(out) or '.'}")

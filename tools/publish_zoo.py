@@ -1,0 +1,77 @@
+"""Publish pretrained payloads into the committed local model zoo.
+
+The reference serves pretrained CNNs with ``layerNames`` for transfer
+learning from an HTTP repo (downloader/src/main/scala/
+ModelDownloader.scala:109-155 ``DefaultModelRepo``). This environment has
+no egress, so the zoo ships IN the repository under ``models/zoo_repo/``:
+this script trains the e303 backbone and publishes it (payload + .meta +
+MANIFEST + .files sidecar) so examples exercise the real
+``ModelDownloader.download_by_name`` path, sha256 verification included.
+
+Run: ``python tools/publish_zoo.py`` (idempotent; regenerates in place).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ZOO = os.path.join(REPO, "models", "zoo_repo")
+
+
+def blob_images(n, seed, classes=2):
+    """Same generator as examples/e303: bright-top vs bright-bottom."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    imgs = []
+    for label in y:
+        img = rng.integers(0, 80, (32, 32, 3))
+        half = slice(0, 16) if label == 0 else slice(16, 32)
+        img[half] += 150
+        imgs.append(np.clip(img, 0, 255).astype(np.uint8))
+    return imgs, y
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.models.zoo import publish_model
+    from mmlspark_tpu.stages.dnn_model import TPUModel
+    from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
+
+    graph = build_model("resnet20_cifar10", width=8)
+    imgs, y = blob_images(256, seed=0)
+    x = np.stack(imgs).astype(np.float32) / 255.0
+    trainer = SPMDTrainer(
+        graph,
+        TrainConfig(epochs=15, batch_size=64, learning_rate=1e-2,
+                    log_every=20),
+    )
+    variables = trainer.train(x, y.astype(np.int32))
+    stage = TPUModel.from_graph(
+        graph, variables, "resnet20_cifar10", model_config={"width": 8},
+        input_col="image", output_col="scores",
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        payload = os.path.join(tmp, "resnet20_blobs")
+        stage.save(payload)
+        schema = publish_model(
+            ZOO,
+            "ResNet20_Blobs",
+            payload,
+            input_node="image",
+            layer_names=tuple(graph.layer_names),
+            dataset="synthetic-blobs",
+            model_type="image-classifier",
+            extra={"width": 8, "input_scale": "1/255"},
+        )
+    print(f"published {schema.name} -> {ZOO} (sha256 {schema.hash[:12]}…, "
+          f"{schema.size} bytes)")
+
+
+if __name__ == "__main__":
+    main()
