@@ -1,0 +1,48 @@
+"""mmlspark_tpu — a TPU-native ML pipeline framework.
+
+A brand-new framework with the capabilities of MMLSpark (reference:
+wangbin321/mmlspark): composable fit/transform pipeline stages over columnar
+datasets in which a compiled neural network is just another stage.
+
+Where the reference routes work through Spark executors, py4j, JNI and external
+``mpiexec cntk`` processes, this framework is idiomatic JAX/XLA:
+
+- single-controller orchestration (one Python process per host),
+- ``jax.jit`` / sharded-``jit`` compiled model stages on TPU,
+- batch sharding over a ``jax.sharding.Mesh`` with gradient sync compiled to
+  XLA collectives over ICI/DCN,
+- a C++ extension op for image decode (the reference's OpenCV JNI layer),
+- step-level checkpointing.
+
+Layer map (mirrors SURVEY.md):
+
+- :mod:`mmlspark_tpu.core`     — params, schema metadata, stages, serialization
+- :mod:`mmlspark_tpu.data`     — columnar Dataset, readers, host->device feed
+- :mod:`mmlspark_tpu.ops`      — device-side image ops + native decode op
+- :mod:`mmlspark_tpu.models`   — flagship model families + model zoo
+- :mod:`mmlspark_tpu.parallel` — mesh / sharding / distributed init
+- :mod:`mmlspark_tpu.stages`   — the ~30 pipeline stages (the public surface)
+- :mod:`mmlspark_tpu.utils`    — small shared utilities
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.stage import (  # noqa: F401
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+)
+from mmlspark_tpu.data.dataset import Dataset  # noqa: F401
+
+
+def all_stages():
+    """Return the registry of every stage class (reference:
+    core/utils/src/main/scala/JarLoadingUtils.scala:18-145 loads every
+    Transformer/Estimator from built jars; here the registry is populated by
+    ``__init_subclass__`` at import time)."""
+    import mmlspark_tpu.stages  # noqa: F401  (import populates the registry)
+
+    return dict(PipelineStage.registry())

@@ -1,0 +1,156 @@
+"""``mml-tpu`` — the framework launcher (the ``mml-exec`` analog).
+
+Reference: tools/bin/mml-exec:1-40 launches spark-shell / pyspark /
+spark-submit / jupyter with ``--packages`` wired to the local MMLSpark
+build. The TPU-native launcher's job is the same — run user code or
+framework tooling inside a correctly-configured environment — minus the
+JVM: it resolves the backend (real TPU vs CPU mesh), then dispatches.
+
+Subcommands:
+  run <script.py> [args...]   run a user script (the spark-submit role)
+  bench                       the repo benchmark (one JSON line)
+  docgen [out_dir]            regenerate API docs (.rst + html)
+  config                      print the resolved app config namespace
+  env                         print the device/topology view
+  zoo list|download <name>    model-zoo operations
+
+Usage: ``python -m mmlspark_tpu <cmd> ...`` or the ``mml-tpu`` console
+script (pyproject [project.scripts]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+
+def _apply_backend(args) -> None:
+    """Backend env must be decided before the first jax import."""
+    if args.cpu_mesh:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.cpu_mesh}"
+        ).strip()
+
+
+def cmd_run(args) -> int:
+    _apply_backend(args)
+    sys.argv = [args.script, *args.script_args]
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    _apply_backend(args)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = os.path.join(repo, "bench.py")
+    if not os.path.exists(bench):
+        print("bench.py not found (installed package without the repo)",
+              file=sys.stderr)
+        return 2
+    runpy.run_path(bench, run_name="__main__")
+    return 0
+
+
+def cmd_docgen(args) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import docgen
+
+    out = args.out_dir
+    paths = docgen.generate(out)
+    html = docgen.render_html(
+        out, os.path.join(os.path.dirname(out) or ".", "html")
+    )
+    print(f"wrote {len(paths)} rst + {len(html)} html files")
+    return 0
+
+
+def cmd_config(args) -> int:
+    from mmlspark_tpu.core import config
+
+    print(json.dumps(config.explain(), indent=1, default=str))
+    return 0
+
+
+def cmd_env(args) -> int:
+    _apply_backend(args)
+    from mmlspark_tpu.core import env
+
+    print(json.dumps(env.describe(), indent=1, default=str))
+    return 0
+
+
+def cmd_zoo(args) -> int:
+    from mmlspark_tpu.models.zoo import ModelDownloader, default_downloader
+
+    if args.local_repo:
+        dl = ModelDownloader(args.local_repo, remote=args.remote)
+    else:
+        dl = default_downloader()
+        if args.remote:
+            from mmlspark_tpu.models.zoo import Repository
+
+            dl.remote = Repository(args.remote)
+    if args.zoo_cmd == "list":
+        names = [s.name for s in dl.local_models()]
+        if dl.remote is not None:
+            names += [
+                f"{s.name} (remote)"
+                for s in dl.remote.list_schemas()
+                if s.name not in names
+            ]
+        print("\n".join(names) if names else "(no models)")
+        return 0
+    schema = dl.download_by_name(args.name)
+    print(f"{schema.name} -> {dl.local_path(schema)}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="mml-tpu", description=__doc__)
+    p.add_argument(
+        "--cpu-mesh", type=int, metavar="N", default=0,
+        help="run on a virtual N-device CPU mesh instead of the default "
+        "backend (the test-tier topology, SURVEY.md §4)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("run", help="run a user script")
+    sp.add_argument("script")
+    sp.add_argument("script_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("bench", help="run the repo benchmark")
+    sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser("docgen", help="regenerate API docs")
+    sp.add_argument("out_dir", nargs="?", default="docs/api")
+    sp.set_defaults(fn=cmd_docgen)
+
+    sp = sub.add_parser("config", help="print resolved app config")
+    sp.set_defaults(fn=cmd_config)
+
+    sp = sub.add_parser("env", help="print device/topology view")
+    sp.set_defaults(fn=cmd_env)
+
+    sp = sub.add_parser("zoo", help="model-zoo operations")
+    sp.add_argument("zoo_cmd", choices=["list", "download"])
+    sp.add_argument("name", nargs="?")
+    sp.add_argument("--local-repo", default="")
+    sp.add_argument("--remote", default="")
+    sp.set_defaults(fn=cmd_zoo)
+
+    args = p.parse_args(argv)
+    if args.cmd == "zoo" and args.zoo_cmd == "download" and not args.name:
+        p.error("zoo download requires a model name")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,2 @@
+"""Core runtime: param system, schema metadata protocol, stage base classes,
+serialization. Mirrors the reference's ``src/core/`` layer (SURVEY.md §2.1)."""

@@ -1,0 +1,13 @@
+"""Namespaced logger factory (reference:
+core/env/src/main/scala/Logging.scala:14-23, loggers namespaced
+``mmlspark.*``)."""
+
+from __future__ import annotations
+
+import logging
+
+NAMESPACE = "mmlspark_tpu"
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    return logging.getLogger(f"{NAMESPACE}.{name}" if name else NAMESPACE)

@@ -1,0 +1,31 @@
+"""Metric value contracts (reference:
+core/contracts/src/main/scala/Metrics.scala:7-46 — ``MetricData``,
+``TypedMetric``, ``MetricGroup``). Evaluators surface metrics both as Dataset
+rows (the primary UX, like the reference's metric DataFrames) and as these
+structured records for logging."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class MetricData:
+    """One named scalar or table metric attached to a model/stage uid."""
+
+    name: str
+    value: Any
+    model: str | None = None
+    group: str | None = None
+    extra: dict = field(default_factory=dict)
+
+    @staticmethod
+    def create(name: str, value: float, model: str | None = None) -> "MetricData":
+        return MetricData(name=name, value=float(value), model=model)
+
+    @staticmethod
+    def create_table(
+        name: str, rows: dict, model: str | None = None
+    ) -> "MetricData":
+        return MetricData(name=name, value=rows, model=model, group="table")

@@ -1,0 +1,7 @@
+"""Data plane: columnar Dataset, file readers, host->device feed.
+
+Mirrors the reference IO layer (SURVEY.md §2.2) with the Spark DataFrame
+replaced by a host-resident columnar dataset feeding sharded device batches.
+"""
+
+from mmlspark_tpu.data.dataset import Dataset  # noqa: F401

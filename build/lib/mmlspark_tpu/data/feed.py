@@ -1,0 +1,143 @@
+"""Host -> device batch feed.
+
+The reference feeds minibatches from Spark row iterators through a lazy
+buffered iterator into JNI (CNTKModel.scala:51-88 ``minibatchIterator``), and
+for training materializes the whole dataset to a file the external trainer
+re-reads (DataConversion.scala:107-174). The TPU-native replacement keeps data
+in host RAM and ships fixed-shape batches straight to device HBM:
+
+- **Fixed shapes**: every batch has exactly ``batch_size`` rows; the tail is
+  padded and a validity mask returned, so a jitted step compiles once
+  (SURVEY.md §7 "ragged/streaming host feed" hard part).
+- **Sharded placement**: with a sharding, ``jax.device_put`` lays the batch
+  out over the mesh's data axis — the replacement for Spark partition ->
+  executor dispatch (CNTKModel.scala:248-256).
+- **Bucketing** limits recompilation for genuinely ragged data (sequences) to
+  one compile per bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import SchemaError
+from mmlspark_tpu.data.dataset import Dataset
+
+MASK_COL = "__mask__"
+
+
+def stack_column(dataset: Dataset, name: str) -> np.ndarray:
+    """A column as one dense ndarray: typed columns pass through; object
+    columns of equal-shape arrays are stacked."""
+    arr = dataset.column(name)
+    if arr.dtype != object:
+        return arr
+    if len(arr) == 0:
+        return np.zeros((0,))
+    first = np.asarray(arr[0])
+    shapes = {np.asarray(v).shape for v in arr}
+    if len(shapes) != 1:
+        raise SchemaError(
+            f"column '{name}' is ragged ({sorted(shapes)}); bucket or pad first"
+        )
+    return np.stack([np.asarray(v) for v in arr]).astype(first.dtype, copy=False)
+
+
+def pad_to(arr: np.ndarray, n: int) -> np.ndarray:
+    """Pad axis 0 to length n by repeating the last row (keeps values in
+    distribution for BN-style stats; mask marks validity)."""
+    if len(arr) == n:
+        return arr
+    if len(arr) == 0:
+        raise SchemaError("cannot pad an empty batch")
+    pad = np.repeat(arr[-1:], n - len(arr), axis=0)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def batch_iterator(
+    dataset: Dataset,
+    columns: Sequence[str],
+    batch_size: int,
+    *,
+    drop_remainder: bool = False,
+    shuffle_seed: int | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield dicts of fixed-shape arrays plus a boolean MASK_COL.
+
+    The analog of the reference's per-partition lazy minibatcher
+    (CNTKModel.scala:51-88) — but shape-stable for XLA.
+    """
+    dataset.require(*columns)
+    n = dataset.num_rows
+    order = np.arange(n)
+    if shuffle_seed is not None:
+        order = np.random.default_rng(shuffle_seed).permutation(n)
+    stacked = {c: stack_column(dataset, c) for c in columns}
+    for start in range(0, n, batch_size):
+        idx = order[start : start + batch_size]
+        if len(idx) < batch_size and drop_remainder:
+            return
+        mask = np.zeros(batch_size, dtype=bool)
+        mask[: len(idx)] = True
+        yield {
+            **{c: pad_to(stacked[c][idx], batch_size) for c in columns},
+            MASK_COL: mask,
+        }
+
+
+def bucket_by_length(
+    dataset: Dataset,
+    column: str,
+    buckets: Sequence[int],
+) -> list[tuple[int, Dataset]]:
+    """Split by ragged-sequence length into (bucket_len, subset) groups; each
+    subset pads its column to bucket_len — one XLA compile per bucket."""
+    arr = dataset.column(column)
+    lengths = np.asarray([len(np.asarray(v)) for v in arr])
+    buckets = sorted(buckets)
+    if not buckets:
+        raise SchemaError("bucket_by_length needs at least one bucket size")
+    if lengths.size and lengths.max() > buckets[-1]:
+        raise SchemaError(
+            f"sequence length {int(lengths.max())} exceeds largest bucket "
+            f"{buckets[-1]}"
+        )
+    out = []
+    assigned = np.zeros(len(arr), dtype=bool)
+    for b in buckets:
+        mask = (~assigned) & (lengths <= b)
+        if not mask.any():
+            continue
+        assigned |= mask
+        subset = dataset.filter(mask)
+        padded = []
+        for v in subset.column(column):
+            v = np.asarray(v)
+            pad_width = [(0, b - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            padded.append(np.pad(v, pad_width))
+        out.append((b, subset.with_column(column, np.stack(padded))))
+    return out
+
+
+# -- device placement --------------------------------------------------------
+
+
+def data_sharding(mesh, axis: str = "data"):
+    """NamedSharding that splits batch dim over the mesh's data axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def to_device(batch: dict[str, np.ndarray], sharding=None) -> dict[str, Any]:
+    """Host batch -> device arrays (replicated, or batch-sharded over a mesh
+    when a sharding is given). The replacement for the reference's
+    JVM->native ``FloatVectorVector`` copies (CNTKModel.scala:66-74)."""
+    import jax
+
+    if sharding is None:
+        return {k: jax.device_put(v) for k, v in batch.items()}
+    return {k: jax.device_put(v, sharding) for k, v in batch.items()}

@@ -1,0 +1,10 @@
+"""Model families: named-block graphs over flax modules, plus the registry
+and model zoo. See :mod:`mmlspark_tpu.models.graph` for the cut-at-node
+abstraction mirroring the reference's CNTK graph surgery."""
+
+from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph  # noqa: F401
+from mmlspark_tpu.models.registry import (  # noqa: F401
+    build_model,
+    register_model,
+    registered_models,
+)

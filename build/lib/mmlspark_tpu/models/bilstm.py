@@ -1,0 +1,72 @@
+"""BiLSTM sequence tagger.
+
+Capability target: reference notebook 304 (Medical Entity Extraction) runs a
+downloaded opaque CNTK BiLSTM graph through CNTKModel with notebook-side
+padding/embedding (SURVEY.md §5 "long-context": the reference has no sequence
+parallelism; sequence handling is pad-to-max + per-token tagging). Here the
+model is first-class: embedding -> bidirectional LSTM (lax.scan under the
+hood via flax nn.RNN — compiler-friendly sequential control flow) -> per-token
+logits. Long sequences shard over the mesh's data axis; sequence-dim sharding
+for multi-chip is provided by the parallel layer (shard_map over tokens), an
+upgrade beyond reference parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph
+from mmlspark_tpu.models.registry import register_model
+
+
+class TokenEmbed(nn.Module):
+    vocab_size: int
+    features: int
+
+    @nn.compact
+    def __call__(self, ids):
+        # ids: (B, T) int32
+        return nn.Embed(self.vocab_size, self.features, param_dtype=jnp.float32)(
+            ids
+        )
+
+
+class BiLSTM(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (B, T, E) -> (B, T, 2*features)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.features))
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.features), reverse=True,
+                     keep_order=True)
+        return nn.Bidirectional(fwd, bwd)(x)
+
+
+class TokenLogits(nn.Module):
+    num_tags: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.num_tags, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("bilstm_tagger")
+def bilstm_tagger(
+    vocab_size: int = 10000,
+    embed_dim: int = 128,
+    hidden: int = 128,
+    num_tags: int = 8,
+) -> NamedGraph:
+    blocks: list[tuple[str, Any]] = [
+        ("embed", TokenEmbed(vocab_size, embed_dim)),
+        ("bilstm", BiLSTM(hidden)),
+        (FINAL_NODE, TokenLogits(num_tags)),
+    ]
+    return NamedGraph(name="bilstm_tagger", blocks=blocks)

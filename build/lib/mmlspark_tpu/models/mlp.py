@@ -1,0 +1,61 @@
+"""MLP / linear model family.
+
+The learners behind ``TrainClassifier``/``TrainRegressor``'s neural options
+(reference supports Spark MLlib LogisticRegression / MultilayerPerceptron /
+LinearRegression among its learner list, TrainClassifier.scala:45-52 and the
+MLP input-layer resize logic at :167-174) and the CNTKLearner's default
+BrainScript nets. Dense layers map straight onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph
+from mmlspark_tpu.models.registry import register_model
+
+
+class DenseRelu(nn.Module):
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.features, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+class DenseOut(nn.Module):
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.features, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("mlp")
+def mlp(
+    num_outputs: int = 2,
+    hidden: Sequence[int] = (128, 128),
+) -> NamedGraph:
+    blocks: list[tuple[str, Any]] = [
+        (f"hidden{i + 1}", DenseRelu(h)) for i, h in enumerate(hidden)
+    ]
+    blocks.append((FINAL_NODE, DenseOut(num_outputs)))
+    return NamedGraph(name="mlp", blocks=blocks)
+
+
+@register_model("linear")
+def linear(num_outputs: int = 1) -> NamedGraph:
+    """Single dense layer: logistic regression (with softmax/sigmoid applied
+    by the loss/eval layer) or linear regression."""
+    return NamedGraph(
+        name="linear", blocks=[(FINAL_NODE, DenseOut(num_outputs))]
+    )

@@ -1,0 +1,150 @@
+"""Mixture-of-experts transformer LM (expert-parallel over ``expert`` axis).
+
+Capability upgrade beyond the reference (SURVEY.md §2.5: no expert
+parallelism anywhere). The FFN of every block is replaced by a top-1
+switch-routed expert bank (:mod:`mmlspark_tpu.parallel.expert`); stacked
+expert params shard over the ``expert`` mesh axis via
+:data:`~mmlspark_tpu.parallel.expert.EXPERT_RULES`, and GSPMD compiles the
+dispatch/combine einsums into all-to-alls over ICI.
+
+The router's load-balancing loss is sown into the ``losses`` collection;
+:class:`~mmlspark_tpu.train.trainer.SPMDTrainer` picks it up automatically
+(``TrainConfig.moe_aux_weight``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import ParamError
+from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph
+from mmlspark_tpu.models.registry import register_model
+from mmlspark_tpu.models.transformer import (
+    AUTO,
+    LMHead,
+    SelfAttention,
+    TokenPosEmbed,
+    resolve_attn_impl,
+)
+from mmlspark_tpu.parallel.expert import moe_ffn, validate_experts
+
+
+class _ExpertParams(nn.Module):
+    """Holds the stacked expert weights under a module named ``experts`` so
+    EXPERT_RULES' path regex places the stacked dim on the expert axis."""
+
+    n_experts: int
+    d_model: int
+    d_ff: int
+
+    @nn.compact
+    def __call__(self):
+        shape_in = (self.n_experts, self.d_model, self.d_ff)
+        shape_out = (self.n_experts, self.d_ff, self.d_model)
+        w_in = self.param("w_in", nn.initializers.lecun_normal(),
+                          shape_in, jnp.float32)
+        b_in = self.param("b_in", nn.initializers.zeros,
+                          (self.n_experts, self.d_ff), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(),
+                           shape_out, jnp.float32)
+        b_out = self.param("b_out", nn.initializers.zeros,
+                           (self.n_experts, self.d_model), jnp.float32)
+        return w_in, b_in, w_out, b_out
+
+
+class MoEFFN(nn.Module):
+    n_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    group_size: int = 1024
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d = x.shape[-1]
+        gate = self.param("gate", nn.initializers.lecun_normal(),
+                          (d, self.n_experts), jnp.float32)
+        w_in, b_in, w_out, b_out = _ExpertParams(
+            self.n_experts, d, self.d_ff, name="experts"
+        )()
+        out, aux = moe_ffn(
+            x.astype(self.dtype), gate, w_in, b_in, w_out, b_out,
+            capacity_factor=self.capacity_factor, mask=mask,
+            group_size=self.group_size,
+        )
+        self.sow("losses", "load_balance", aux)
+        return out.astype(x.dtype)
+
+
+class MoEBlock(nn.Module):
+    heads: int
+    head_dim: int
+    n_experts: int
+    d_ff: int
+    causal: bool
+    capacity_factor: float
+    attn_impl: str = AUTO
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + SelfAttention(self.heads, self.head_dim, self.causal,
+                              resolve_attn_impl(self.attn_impl), None,
+                              self.dtype, name="attn")(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = MoEFFN(self.n_experts, self.d_ff, self.capacity_factor,
+                   self.dtype, name="moe")(y, mask)
+        return x + y
+
+
+@register_model("transformer_lm_moe")
+def transformer_lm_moe(
+    vocab_size: int = 1024,
+    d_model: int = 128,
+    heads: int = 4,
+    depth: int = 2,
+    n_experts: int = 8,
+    d_ff: int = 0,
+    max_len: int = 512,
+    causal: bool = True,
+    capacity_factor: float = 1.25,
+    attn_impl: str = AUTO,
+    mesh: Any = None,
+) -> NamedGraph:
+    """Decoder-only switch-MoE LM; every block's FFN is expert-routed."""
+    if d_model % heads:
+        raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
+    from mmlspark_tpu.models.transformer import ATTN_IMPLS
+
+    if attn_impl not in ATTN_IMPLS:
+        raise ParamError(
+            f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
+        )
+    validate_experts(n_experts, mesh)
+    d_ff = d_ff or 4 * d_model
+    blocks: list[tuple[str, Any]] = [
+        ("embed", TokenPosEmbed(vocab_size, d_model, max_len))
+    ]
+    for i in range(depth):
+        blocks.append(
+            (
+                f"block{i}",
+                MoEBlock(heads, d_model // heads, n_experts, d_ff, causal,
+                         capacity_factor, attn_impl),
+            )
+        )
+    blocks.append((FINAL_NODE, LMHead(vocab_size)))
+    return NamedGraph(
+        name="transformer_lm_moe",
+        blocks=blocks,
+        input_shape=(max_len,),
+        extra={
+            "vocab_size": vocab_size,
+            "n_experts": n_experts,
+            "causal": causal,
+        },
+    )

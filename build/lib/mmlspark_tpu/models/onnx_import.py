@@ -1,0 +1,769 @@
+"""ONNX graph import: serialized model file -> executable JAX graph.
+
+The reference's DNN stage loads serialized CNTK-v2 protobuf graphs through
+JNI (``Function.load``, cntk-model/src/main/scala/SerializableFunction.scala:
+19-38) and does node-name surgery on them (CNTKModel.scala:97-108). SURVEY.md
+§7 flags graph conversion as a hard part: *node-name preservation is
+load-bearing* — ``layerNames`` truncation drives ImageFeaturizer
+(image-featurizer/.../ImageFeaturizer.scala:122).
+
+TPU-native equivalent: parse the ONNX protobuf directly (a small wire-format
+decoder — no onnx/protoc dependency; the format is stable and simple),
+convert each node to a jnp/lax op, and expose the result as an
+:class:`OnnxGraph` with the same named-node protocol as
+:class:`~mmlspark_tpu.models.graph.NamedGraph`: ``layer_names``,
+``apply(..., output_node=...)`` (stop at any node — the AsComposite
+equivalent), ``cut``. The whole converted graph jit-compiles; XLA fuses it
+for the MXU exactly like a hand-written model.
+
+Registered as model ``"onnx"`` (config: ``path``) so serialized
+:class:`~mmlspark_tpu.stages.dnn_model.TPUModel` stages rebuild it on load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.models.registry import register_model
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format decoding (proto3 subset: varint, 64-bit, length-
+# delimited, 32-bit)
+# ---------------------------------------------------------------------------
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    r = 0
+    sh = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << sh
+        if not b & 0x80:
+            return r, i
+        sh += 7
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def _fields(buf: bytes) -> dict[int, list[tuple[int, Any]]]:
+    """Decode one message into {field_number: [(wire_type, raw_value)]}."""
+    i = 0
+    out: dict[int, list] = {}
+    while i < len(buf):
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:  # pragma: no cover
+            raise FriendlyError(f"unsupported protobuf wire type {wt}")
+        out.setdefault(fn, []).append((wt, v))
+    return out
+
+
+def _first(fs, n, default=None):
+    vals = fs.get(n)
+    return vals[0][1] if vals else default
+
+
+def _int(fs, n, default=0) -> int:
+    v = _first(fs, n)
+    return default if v is None else int(v)
+
+
+def _str(fs, n, default="") -> str:
+    v = _first(fs, n)
+    return default if v is None else v.decode("utf-8")
+
+
+def _strs(fs, n) -> list[str]:
+    return [v.decode("utf-8") for _, v in fs.get(n, [])]
+
+
+def _ints(fs, n) -> list[int]:
+    """Repeated int64: mix of plain varints and packed chunks."""
+    out: list[int] = []
+    for wt, v in fs.get(n, []):
+        if wt == 0:
+            out.append(_signed(v))
+        else:  # packed
+            i = 0
+            while i < len(v):
+                x, i = _varint(v, i)
+                out.append(_signed(x))
+    return out
+
+
+def _floats(fs, n) -> list[float]:
+    out: list[float] = []
+    for wt, v in fs.get(n, []):
+        if wt == 5:
+            out.append(float(np.frombuffer(v, "<f4")[0]))
+        else:  # packed
+            out.extend(np.frombuffer(v, "<f4").tolist())
+    return out
+
+
+_DTYPES = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16, 6: np.int32,
+    7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+
+
+def _tensor(buf: bytes) -> tuple[str, np.ndarray]:
+    fs = _fields(buf)
+    dims = _ints(fs, 1)
+    dt = _int(fs, 2, 1)
+    name = _str(fs, 8)
+    if dt not in _DTYPES:
+        raise FriendlyError(f"unsupported ONNX tensor dtype {dt} ({name})")
+    dtype = _DTYPES[dt]
+    raw = _first(fs, 9)
+    if raw is not None:
+        arr = np.frombuffer(raw, dtype=dtype)
+    elif dt == 1:
+        arr = np.array(_floats(fs, 4), np.float32)
+    elif dt in (6, 7):
+        arr = np.array(_ints(fs, 5 if dt == 6 else 7),
+                       _DTYPES[dt])
+    elif dt == 11:
+        arr = np.concatenate(
+            [np.frombuffer(v, "<f8") for _, v in fs.get(10, [])]
+        ) if fs.get(10) else np.array([], np.float64)
+    else:
+        raise FriendlyError(f"tensor '{name}': no data fields for dtype {dt}")
+    return name, arr.reshape(dims) if dims else arr
+
+
+@dataclasses.dataclass
+class _Attr:
+    f: float = 0.0
+    i: int = 0
+    s: str = ""
+    t: np.ndarray | None = None
+    floats: tuple = ()
+    ints: tuple = ()
+    strings: tuple = ()
+
+
+def _attributes(node_fs) -> dict[str, _Attr]:
+    out: dict[str, _Attr] = {}
+    for _, buf in node_fs.get(5, []):
+        fs = _fields(buf)
+        a = _Attr(
+            f=float(np.frombuffer(_first(fs, 2, b"\0\0\0\0"), "<f4")[0]),
+            i=_signed(_int(fs, 3)),
+            s=_str(fs, 4),
+            floats=tuple(_floats(fs, 7)),
+            ints=tuple(_ints(fs, 8)),
+            strings=tuple(_strs(fs, 9)),
+        )
+        if fs.get(5):
+            a.t = _tensor(_first(fs, 5))[1]
+        out[_str(fs, 1)] = a
+    return out
+
+
+@dataclasses.dataclass
+class OnnxNode:
+    name: str
+    op: str
+    inputs: list[str]
+    outputs: list[str]
+    attrs: dict[str, _Attr]
+
+
+# ---------------------------------------------------------------------------
+# op conversion (NCHW, matching ONNX conventions)
+# ---------------------------------------------------------------------------
+
+
+def _conv(x, w, b, a: dict[str, _Attr]):
+    import jax.numpy as jnp
+    from jax import lax
+
+    spatial = w.ndim - 2
+    strides = tuple(a["strides"].ints) if "strides" in a else (1,) * spatial
+    dil = tuple(a["dilations"].ints) if "dilations" in a else (1,) * spatial
+    group = a["group"].i if "group" in a else 1
+    if "pads" in a and a["pads"].ints:
+        p = a["pads"].ints
+        padding = tuple((p[i], p[i + spatial]) for i in range(spatial))
+    elif a.get("auto_pad") and a["auto_pad"].s in ("SAME_UPPER", "SAME_LOWER"):
+        # ONNX puts the odd padding pixel at the END for SAME_UPPER and at
+        # the START for SAME_LOWER; lax's "SAME" string is upper-only, so
+        # compute explicit per-side pads from the static input shape
+        lower = a["auto_pad"].s == "SAME_LOWER"
+        padding = []
+        for i in range(spatial):
+            size = x.shape[2 + i]
+            k_eff = (w.shape[2 + i] - 1) * dil[i] + 1
+            total = max(
+                0, (-(-size // strides[i]) - 1) * strides[i] + k_eff - size
+            )
+            small, big = total // 2, total - total // 2
+            padding.append((big, small) if lower else (small, big))
+        padding = tuple(padding)
+    else:
+        padding = tuple((0, 0) for _ in range(spatial))
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCW", "OIW", "NCW")
+    y = lax.conv_general_dilated(
+        x, jnp.asarray(w), strides, padding, rhs_dilation=dil,
+        dimension_numbers=dn, feature_group_count=group,
+    )
+    if b is not None:
+        y = y + jnp.asarray(b).reshape((1, -1) + (1,) * spatial)
+    return y
+
+
+def _pool(x, a: dict[str, _Attr], kind: str):
+    import jax.numpy as jnp
+    from jax import lax
+
+    if a.get("ceil_mode") and a["ceil_mode"].i:
+        raise FriendlyError(
+            "pool ceil_mode=1 is not supported (reduce_window floors the "
+            "output shape); re-export the model with ceil_mode=0"
+        )
+    k = tuple(a["kernel_shape"].ints)
+    spatial = len(k)
+    strides = tuple(a["strides"].ints) if "strides" in a else k
+    if "pads" in a and a["pads"].ints:
+        p = a["pads"].ints
+        pads = tuple((p[i], p[i + spatial]) for i in range(spatial))
+    else:
+        pads = tuple((0, 0) for _ in range(spatial))
+    window = (1, 1) + k
+    ws = (1, 1) + strides
+    wp = ((0, 0), (0, 0)) + pads
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, ws, wp)
+    total = lax.reduce_window(x, 0.0, lax.add, window, ws, wp)
+    if a.get("count_include_pad") and a["count_include_pad"].i:
+        return total / float(np.prod(k))
+    ones = jnp.ones(x.shape, x.dtype)
+    count = lax.reduce_window(ones, 0.0, lax.add, window, ws, wp)
+    return total / count
+
+
+def _gemm(x, w, b, a: dict[str, _Attr]):
+    import jax.numpy as jnp
+
+    alpha = a["alpha"].f if "alpha" in a else 1.0
+    beta = a["beta"].f if "beta" in a else 1.0
+    if a.get("transA") and a["transA"].i:
+        x = x.T
+    if a.get("transB") and a["transB"].i:
+        w = w.T
+    y = alpha * (x @ w)
+    if b is not None:
+        y = y + beta * b
+    return y
+
+
+def _opt_input(node, env, i):
+    """Optional ONNX input: None when absent or named '' (spec sentinel)."""
+    if i >= len(node.inputs) or not node.inputs[i]:
+        return None
+    return env[node.inputs[i]]
+
+
+#: scan directions per the RNN 'direction' attribute; reverse=True flips
+#: the sequence before and after the scan
+_RNN_DIRECTIONS = {
+    "": (False,),
+    "forward": (False,),
+    "reverse": (True,),
+    "bidirectional": (False, True),
+}
+
+_DEFAULT_ACTS = {
+    "LSTM": ("Sigmoid", "Tanh", "Tanh"),
+    "GRU": ("Sigmoid", "Tanh"),
+}
+
+
+def _rnn_parts(node, env, a, n_gates: int):
+    """Common LSTM/GRU input unpacking per the ONNX spec: X (S, B, I),
+    W (D, n_gates*H, I), R (D, n_gates*H, H), optional B (D, 2*n_gates*H).
+    Returns (x, w, r, wb, rb, hidden, reverses)."""
+    import jax.numpy as jnp
+
+    x, w, r = (_opt_input(node, env, i) for i in range(3))
+    hidden = a["hidden_size"].i if "hidden_size" in a else r.shape[-1]
+    direction = a["direction"].s if "direction" in a else ""
+    if direction not in _RNN_DIRECTIONS:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': unknown direction "
+            f"'{direction}'"
+        )
+    reverses = _RNN_DIRECTIONS[direction]
+    dirs = w.shape[0]
+    if dirs != len(reverses):
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': weight dirs {dirs} != "
+            f"direction '{direction or 'forward'}'"
+        )
+    acts = tuple(a["activations"].strings) if "activations" in a else ()
+    if acts and acts != _DEFAULT_ACTS[node.op] * dirs:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': only default activations "
+            f"{_DEFAULT_ACTS[node.op]} are supported, got {acts}"
+        )
+    b = _opt_input(node, env, 3)
+    if b is None:
+        wb = jnp.zeros((dirs, n_gates * hidden), x.dtype)
+        rb = jnp.zeros((dirs, n_gates * hidden), x.dtype)
+    else:
+        wb, rb = b[:, : n_gates * hidden], b[:, n_gates * hidden:]
+    if _opt_input(node, env, 4) is not None:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': per-row sequence_lens is not "
+            "supported — pad to a fixed length (data/feed.py bucketing)"
+        )
+    return x, w, r, wb, rb, hidden, reverses
+
+
+def _scan_direction(step, x, carry, reverse: bool):
+    import jax
+
+    xs = x[::-1] if reverse else x
+    carry, ys = jax.lax.scan(step, carry, xs)
+    return carry, (ys[::-1] if reverse else ys)
+
+
+def _onnx_lstm(node, env, a):
+    """ONNX LSTM (opset 7+ semantics, default activations; gate order
+    i, o, f, c). Outputs Y (S, D, B, H), Y_h (D, B, H), Y_c (D, B, H).
+    Implemented as lax.scan per direction — compiler-friendly recurrence
+    (the CNTK-v2 BiLSTM graph of notebook 304 maps onto this)."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    x, w, r, wb, rb, hidden, reverses = _rnn_parts(node, env, a, 4)
+    s, batch, _ = x.shape
+    dirs = len(reverses)
+    if _opt_input(node, env, 7) is not None:
+        raise FriendlyError(
+            f"ONNX LSTM '{node.name}': peephole weights (input P) are "
+            "not supported"
+        )
+
+    h0 = _opt_input(node, env, 5)
+    c0 = _opt_input(node, env, 6)
+    h0 = jnp.zeros((dirs, batch, hidden), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((dirs, batch, hidden), x.dtype) if c0 is None else c0
+
+    ys, hts, cts = [], [], []
+    for d, rev in enumerate(reverses):
+        wd, rd, wbd, rbd = w[d], r[d], wb[d], rb[d]
+
+        def step(carry, xt, wd=wd, rd=rd, wbd=wbd, rbd=rbd):
+            h, c = carry
+            g = xt @ wd.T + h @ rd.T + wbd + rbd
+            i_, o, f, cc = jnp.split(g, 4, axis=-1)
+            c_new = jnn.sigmoid(f) * c + jnn.sigmoid(i_) * jnp.tanh(cc)
+            h_new = jnn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (ht, ct), y = _scan_direction(step, x, (h0[d], c0[d]), reverse=rev)
+        ys.append(y)
+        hts.append(ht)
+        cts.append(ct)
+    y = jnp.stack(ys, axis=1)  # (S, D, B, H)
+    return [y, jnp.stack(hts), jnp.stack(cts)]
+
+
+def _onnx_gru(node, env, a):
+    """ONNX GRU (gate order z, r, h; ``linear_before_reset`` honored)."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    x, w, r, wb, rb, hidden, reverses = _rnn_parts(node, env, a, 3)
+    s, batch, _ = x.shape
+    dirs = len(reverses)
+    lbr = bool(a["linear_before_reset"].i) if "linear_before_reset" in a \
+        else False
+
+    h0 = _opt_input(node, env, 5)
+    h0 = jnp.zeros((dirs, batch, hidden), x.dtype) if h0 is None else h0
+
+    ys, hts = [], []
+    for d, rev in enumerate(reverses):
+        wd, rd, wbd, rbd = w[d], r[d], wb[d], rb[d]
+        wz, wr_, wh = jnp.split(wd, 3, axis=0)
+        rz, rr, rh = jnp.split(rd, 3, axis=0)
+        wbz, wbr, wbh = jnp.split(wbd, 3)
+        rbz, rbr, rbh = jnp.split(rbd, 3)
+
+        def step(carry, xt, wz=wz, wr_=wr_, wh=wh, rz=rz, rr=rr, rh=rh,
+                 wbz=wbz, wbr=wbr, wbh=wbh, rbz=rbz, rbr=rbr, rbh=rbh):
+            h = carry
+            z = jnn.sigmoid(xt @ wz.T + h @ rz.T + wbz + rbz)
+            rg = jnn.sigmoid(xt @ wr_.T + h @ rr.T + wbr + rbr)
+            if lbr:
+                hh = jnp.tanh(xt @ wh.T + rg * (h @ rh.T + rbh) + wbh)
+            else:
+                hh = jnp.tanh(xt @ wh.T + (rg * h) @ rh.T + wbh + rbh)
+            h_new = (1.0 - z) * hh + z * h
+            return h_new, h_new
+
+        ht, y = _scan_direction(step, x, h0[d], reverse=rev)
+        ys.append(y)
+        hts.append(ht)
+    return [jnp.stack(ys, axis=1), jnp.stack(hts)]
+
+
+def _static_ints(env, name, consts) -> list[int]:
+    if name in consts:
+        return [int(v) for v in np.asarray(consts[name]).ravel()]
+    raise FriendlyError(
+        f"'{name}' must be a constant (initializer or Constant node) — "
+        "data-dependent shapes can't compile for TPU"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the executable graph
+# ---------------------------------------------------------------------------
+
+
+class OnnxGraph:
+    """Topologically-ordered ONNX nodes executed with jnp/lax ops.
+
+    Duck-types the :class:`NamedGraph` protocol (``layer_names``, ``apply``
+    with ``output_node``, ``cut``, ``init``, ``param_count``) so
+    ``TPUModel.from_graph`` and ``ImageFeaturizer`` work unchanged on
+    imported models.
+    """
+
+    def __init__(self, name: str, nodes: list[OnnxNode],
+                 initializers: dict[str, np.ndarray],
+                 input_name: str, output_name: str,
+                 input_shape: tuple = ()):
+        self.name = name
+        self.nodes = nodes
+        self.initializers = initializers
+        self.input_name = input_name
+        self.output_name = output_name
+        self.input_shape = input_shape
+        self.compute_dtype = None
+        self.extra: dict = {"format": "onnx"}
+
+    # -- NamedGraph protocol -------------------------------------------------
+
+    @property
+    def layer_names(self) -> list[str]:
+        return [n.name for n in self.nodes]
+
+    @property
+    def blocks(self):  # parity helper: (name, node) pairs
+        return [(n.name, n) for n in self.nodes]
+
+    def _check_node(self, node: str | int | None) -> str | None:
+        from mmlspark_tpu.models.graph import resolve_node
+
+        return resolve_node(self.layer_names, node, self.name)
+
+    def init(self, rng=None, sample=None) -> dict:
+        """Imported graphs arrive trained; variables are the initializers."""
+        return {"onnx": {"params": dict(self.initializers)}}
+
+    def apply(self, variables, x, output_node: str | int | None = None,
+              train: bool = False, rngs=None, mask=None):
+        # mask accepted for trainer-interface uniformity; imported graphs
+        # have no routing/stats that depend on padding rows
+        import jax.numpy as jnp
+
+        params = variables["onnx"]["params"]
+        stop = self._check_node(output_node)
+        env: dict[str, Any] = {
+            k: jnp.asarray(v) for k, v in params.items()
+        }
+        consts: dict[str, np.ndarray] = dict(params)
+        env[self.input_name] = x
+        out = None
+        for node in self.nodes:
+            vals = _apply_node(node, env, consts)
+            for oname, v in zip(node.outputs, vals):
+                env[oname] = v
+            out = vals[0]
+            if node.name == stop:
+                break
+        if stop is None and self.output_name in env:
+            out = env[self.output_name]
+        return (out, variables) if train else out
+
+    def cut(self, node: str | int) -> "OnnxGraph":
+        stop = self._check_node(node)
+        idx = self.layer_names.index(stop)
+        kept = self.nodes[: idx + 1]
+        return OnnxGraph(
+            name=f"{self.name}@{stop}",
+            nodes=kept,
+            initializers=self.initializers,
+            input_name=self.input_name,
+            output_name=kept[-1].outputs[0],
+            input_shape=self.input_shape,
+        )
+
+    def param_count(self, variables=None) -> int:
+        src = (
+            variables["onnx"]["params"] if variables else self.initializers
+        )
+        return sum(int(np.asarray(v).size) for v in src.values())
+
+
+def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    a = node.attrs
+    op = node.op
+
+    def inp(i, default=None):
+        v = _opt_input(node, env, i)
+        return default if v is None else v
+
+    if op == "Conv":
+        return [_conv(inp(0), inp(1), inp(2), a)]
+    if op == "Gemm":
+        return [_gemm(inp(0), inp(1), inp(2), a)]
+    if op == "MatMul":
+        return [inp(0) @ inp(1)]
+    if op == "Add":
+        return [inp(0) + inp(1)]
+    if op == "Sub":
+        return [inp(0) - inp(1)]
+    if op == "Mul":
+        return [inp(0) * inp(1)]
+    if op == "Div":
+        return [inp(0) / inp(1)]
+    if op == "Relu":
+        return [jax.nn.relu(inp(0))]
+    if op == "LeakyRelu":
+        alpha = a["alpha"].f if "alpha" in a else 0.01
+        return [jax.nn.leaky_relu(inp(0), alpha)]
+    if op == "Sigmoid":
+        return [jax.nn.sigmoid(inp(0))]
+    if op == "Tanh":
+        return [jnp.tanh(inp(0))]
+    if op == "Erf":
+        return [jax.scipy.special.erf(inp(0))]
+    if op == "Sqrt":
+        return [jnp.sqrt(inp(0))]
+    if op == "Pow":
+        return [inp(0) ** inp(1)]
+    if op == "Exp":
+        return [jnp.exp(inp(0))]
+    if op == "Softmax":
+        axis = a["axis"].i if "axis" in a else -1
+        return [jax.nn.softmax(inp(0), axis=axis)]
+    if op == "MaxPool":
+        return [_pool(inp(0), a, "max")]
+    if op == "AveragePool":
+        return [_pool(inp(0), a, "avg")]
+    if op == "GlobalAveragePool":
+        x = inp(0)
+        return [x.mean(axis=tuple(range(2, x.ndim)), keepdims=True)]
+    if op == "BatchNormalization":
+        x, scale, bias, mean, var = (inp(i) for i in range(5))
+        eps = a["epsilon"].f if "epsilon" in a else 1e-5
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return [
+            (x - mean.reshape(shape))
+            * (scale.reshape(shape) / jnp.sqrt(var.reshape(shape) + eps))
+            + bias.reshape(shape)
+        ]
+    if op == "Flatten":
+        axis = a["axis"].i if "axis" in a else 1
+        x = inp(0)
+        lead = int(np.prod(x.shape[:axis])) if axis else 1
+        return [x.reshape(lead, -1)]
+    if op == "Reshape":
+        x = inp(0)
+        shape = _static_ints(env, node.inputs[1], consts)
+        shape = [
+            x.shape[i] if s == 0 else s for i, s in enumerate(shape)
+        ]
+        return [x.reshape(shape)]
+    if op == "Transpose":
+        perm = list(a["perm"].ints) if "perm" in a else None
+        return [jnp.transpose(inp(0), perm)]
+    if op == "Concat":
+        xs = [env[i] for i in node.inputs]
+        return [jnp.concatenate(xs, axis=a["axis"].i)]
+    if op in ("Identity", "Dropout"):  # Dropout = identity at inference
+        return [inp(0)]
+    if op == "Constant":
+        val = a["value"].t
+        consts[node.outputs[0]] = val
+        return [jnp.asarray(val)]
+    if op == "Squeeze":
+        axes = (_static_ints(env, node.inputs[1], consts)
+                if len(node.inputs) > 1 else list(a.get("axes", _Attr()).ints))
+        return [jnp.squeeze(inp(0), axis=tuple(axes) if axes else None)]
+    if op == "Unsqueeze":
+        axes = (_static_ints(env, node.inputs[1], consts)
+                if len(node.inputs) > 1 else list(a["axes"].ints))
+        x = inp(0)
+        for ax in sorted(axes):
+            x = jnp.expand_dims(x, ax)
+        return [x]
+    if op == "ReduceMean":
+        axes = tuple(a["axes"].ints) if "axes" in a else None
+        keep = bool(a["keepdims"].i) if "keepdims" in a else True
+        return [inp(0).mean(axis=axes, keepdims=keep)]
+    if op == "Gather":
+        axis = a["axis"].i if "axis" in a else 0
+        return [jnp.take(inp(0), inp(1).astype(jnp.int32), axis=axis)]
+    if op == "Clip":
+        lo = inp(1, a["min"].f if "min" in a else None)
+        hi = inp(2, a["max"].f if "max" in a else None)
+        return [jnp.clip(inp(0), lo, hi)]
+    if op == "Sum":
+        out = env[node.inputs[0]]
+        for nm in node.inputs[1:]:
+            out = out + env[nm]
+        return [out]
+    if op == "Slice":
+        x = inp(0)
+        if len(node.inputs) > 1:  # opset 10+: starts/ends/axes/steps inputs
+            starts = _static_ints(env, node.inputs[1], consts)
+            ends = _static_ints(env, node.inputs[2], consts)
+            axes = (_static_ints(env, node.inputs[3], consts)
+                    if len(node.inputs) > 3 and node.inputs[3]
+                    else list(range(len(starts))))
+            steps = (_static_ints(env, node.inputs[4], consts)
+                     if len(node.inputs) > 4 and node.inputs[4]
+                     else [1] * len(starts))
+        else:  # opset 1: attributes
+            starts = list(a["starts"].ints)
+            ends = list(a["ends"].ints)
+            axes = (list(a["axes"].ints) if "axes" in a
+                    else list(range(len(starts))))
+            steps = [1] * len(starts)
+        idx = [slice(None)] * x.ndim
+        for st, en, ax, sp in zip(starts, ends, axes, steps):
+            # python slices already clamp INT_MAX-style sentinels and
+            # accept negative indices, matching ONNX Slice semantics
+            idx[ax] = slice(st, en, sp)
+        return [x[tuple(idx)]]
+    if op == "LSTM":
+        return _onnx_lstm(node, env, a)
+    if op == "GRU":
+        return _onnx_gru(node, env, a)
+    raise FriendlyError(
+        f"unsupported ONNX op '{op}' (node '{node.name}'); supported ops "
+        "cover the CNN/MLP families — extend _apply_node for more"
+    )
+
+
+# ---------------------------------------------------------------------------
+# model file -> OnnxGraph
+# ---------------------------------------------------------------------------
+
+
+def load_onnx(src) -> OnnxGraph:
+    """Parse an ONNX file path or bytes into an :class:`OnnxGraph`."""
+    if isinstance(src, (str, bytes)) and not isinstance(src, bytes):
+        with open(src, "rb") as f:
+            data = f.read()
+        name = str(src)
+    else:
+        data = src
+        name = "onnx"
+    model = _fields(data)
+    graph_buf = _first(model, 7)
+    if graph_buf is None:
+        raise FriendlyError("not an ONNX ModelProto (no graph field)")
+    g = _fields(graph_buf)
+    gname = _str(g, 2) or name
+
+    initializers: dict[str, np.ndarray] = {}
+    for _, buf in g.get(5, []):
+        tname, arr = _tensor(buf)
+        initializers[tname] = arr
+
+    nodes: list[OnnxNode] = []
+    seen: set[str] = set()
+    for idx, (_, buf) in enumerate(g.get(1, [])):
+        fs = _fields(buf)
+        outputs = _strs(fs, 2)
+        nm = _str(fs, 3) or (outputs[0] if outputs else f"node{idx}")
+        if nm in seen:  # uniquify: names address nodes
+            nm = f"{nm}#{idx}"
+        seen.add(nm)
+        nodes.append(
+            OnnxNode(
+                name=nm,
+                op=_str(fs, 4),
+                inputs=_strs(fs, 1),
+                outputs=outputs,
+                attrs=_attributes(fs),
+            )
+        )
+
+    input_name = ""
+    input_shape: tuple = ()
+    for _, buf in g.get(11, []):  # graph inputs
+        fs = _fields(buf)
+        nm = _str(fs, 1)
+        if nm not in initializers:
+            input_name = nm
+            input_shape = _value_info_shape(fs)
+            break
+    out_name = ""
+    outs = g.get(12, [])
+    if outs:
+        out_name = _str(_fields(outs[0][1]), 1)
+    if not input_name:
+        raise FriendlyError("ONNX graph has no non-initializer input")
+    return OnnxGraph(
+        name=gname,
+        nodes=nodes,
+        initializers=initializers,
+        input_name=input_name,
+        output_name=out_name,
+        input_shape=input_shape,
+    )
+
+
+def _value_info_shape(fs) -> tuple:
+    type_buf = _first(fs, 2)
+    if type_buf is None:
+        return ()
+    tt = _first(_fields(type_buf), 1)
+    if tt is None:
+        return ()
+    shape_buf = _first(_fields(tt), 2)
+    if shape_buf is None:
+        return ()
+    dims = []
+    for _, dbuf in _fields(shape_buf).get(1, []):
+        dims.append(_int(_fields(dbuf), 1, -1))
+    return tuple(dims[1:])  # drop batch dim
+
+
+@register_model("onnx")
+def _onnx_builder(path: str = "", **_ignored) -> OnnxGraph:
+    if not path:
+        raise FriendlyError("model 'onnx' needs config {'path': <file>}")
+    return load_onnx(path)

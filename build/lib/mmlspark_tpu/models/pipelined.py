@@ -1,0 +1,198 @@
+"""Pipeline-parallel transformer LM.
+
+The trunk (a stack of identical transformer Blocks) is partitioned into
+``pipe``-axis stages and executed with
+:func:`mmlspark_tpu.parallel.pipeline.pipeline_apply`; the embedding and LM
+head run data-parallel outside the pipeline (they are not homogeneous with
+the trunk). No reference counterpart exists — the reference's only
+parallelism is data parallelism (SURVEY.md §2.5); this is part of the
+first-class distributed design the TPU build adds.
+
+Duck-types :class:`~mmlspark_tpu.models.graph.NamedGraph` (init / apply /
+layer_names / param_count) so :class:`~mmlspark_tpu.train.trainer.SPMDTrainer`
+drives it unchanged — pass ``param_rules=PIPELINE_STAGE_RULES`` (plus a mesh
+with a ``pipe`` axis) and the stacked stage params shard one-stage-per-rank.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import flax.linen as nn
+
+from mmlspark_tpu.core.exceptions import FriendlyError, ParamError
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.models.graph import FINAL_NODE
+from mmlspark_tpu.models.registry import register_model
+from mmlspark_tpu.models.transformer import Block, LMHead, TokenPosEmbed
+from mmlspark_tpu.parallel.mesh import PIPELINE_AXIS
+
+_log = get_logger("models.pipelined")
+
+
+class _Stage(nn.Module):
+    """One pipeline stage: ``layers`` consecutive transformer Blocks."""
+
+    layers: int
+    heads: int
+    head_dim: int
+    d_ff: int
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.layers):
+            x = Block(self.heads, self.head_dim, self.d_ff, self.causal,
+                      "dense", None, name=f"layer{i}")(x)
+        return x
+
+
+@dataclass
+class PipelinedGraph:
+    """NamedGraph-shaped wrapper whose trunk runs as a device pipeline.
+
+    Variables layout: ``{"embed": ..., "stages": ..., "z": ...}`` where
+    ``stages`` params carry a leading stacked dim of size ``n_stages``.
+    """
+
+    name: str
+    embed: Any
+    stage: Any
+    head: Any
+    n_stages: int
+    n_microbatches: int
+    mesh: Any
+    input_shape: tuple = ()
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def layer_names(self) -> list[str]:
+        return ["embed", "stages", FINAL_NODE]
+
+    def init(self, rng, sample):
+        r_embed, r_stage, r_head = jax.random.split(rng, 3)
+        v_embed = self.embed.init({"params": r_embed}, sample)
+        x = self.embed.apply(v_embed, sample)
+        stage_rngs = jax.random.split(r_stage, self.n_stages)
+        v_stages = jax.vmap(
+            lambda r: self.stage.init({"params": r}, x)
+        )(stage_rngs)
+        # thread the sample through every stage so the head sees the true
+        # trunk output shape (shapes are stage-invariant by construction)
+        for i in range(self.n_stages):
+            v_i = jax.tree_util.tree_map(lambda a, i=i: a[i], v_stages)
+            x = self.stage.apply(v_i, x)
+        v_head = self.head.init({"params": r_head}, x)
+        return {"embed": v_embed, "stages": v_stages, FINAL_NODE: v_head}
+
+    def apply(self, variables, x, output_node=None, train: bool = False,
+              rngs=None, mask=None):
+        from mmlspark_tpu.models.graph import resolve_node
+        from mmlspark_tpu.parallel.pipeline import pipeline_apply
+
+        stop = resolve_node(self.layer_names, output_node, self.name)
+        h = self.embed.apply(variables["embed"], x)
+        if stop == "embed":
+            return (h, variables) if train else h
+        b = h.shape[0]
+        m = self._pick_microbatches(b)
+        if m is None:
+            # no valid microbatching for this batch (tiny init/probe
+            # traces, or a batch not divisible into stage multiples):
+            # sequential stage application — same math, no pipeline
+            for i in range(self.n_stages):
+                v_i = jax.tree_util.tree_map(
+                    lambda a, i=i: a[i], variables["stages"]
+                )
+                h = self.stage.apply(v_i, h)
+        else:
+            mb = h.reshape((m, b // m) + h.shape[1:])
+            out = pipeline_apply(
+                lambda p, t: self.stage.apply(p, t),
+                variables["stages"],
+                mb,
+                self.mesh,
+            )
+            h = out.reshape((b,) + out.shape[2:])
+        if stop == "stages":
+            return (h, variables) if train else h
+        logits = self.head.apply(variables[FINAL_NODE], h)
+        return (logits, variables) if train else logits
+
+    def _pick_microbatches(self, batch: int) -> int | None:
+        """Largest microbatch count <= n_microbatches that divides
+        ``batch`` and is a stage-count multiple; None when the pipeline
+        schedule cannot run (falls back to sequential stages)."""
+        for m in range(min(self.n_microbatches, batch), 0, -1):
+            if batch % m == 0 and m % self.n_stages == 0:
+                return m
+        if batch >= self.n_stages:
+            _log.warning(
+                "batch %d not divisible into %d-stage microbatches; "
+                "running stages sequentially (no pipelining) — pick a "
+                "batch size divisible by n_microbatches (%d)",
+                batch, self.n_stages, self.n_microbatches,
+            )
+        return None
+
+    def param_count(self, variables) -> int:
+        from mmlspark_tpu.models.graph import count_params
+
+        return count_params(variables)
+
+
+@register_model("transformer_lm_pipelined")
+def transformer_lm_pipelined(
+    vocab_size: int = 1024,
+    d_model: int = 128,
+    heads: int = 4,
+    depth: int = 4,
+    d_ff: int = 0,
+    max_len: int = 512,
+    causal: bool = True,
+    mesh: Any = None,
+    n_stages: int | None = None,
+    n_microbatches: int | None = None,
+) -> PipelinedGraph:
+    """Decoder-only LM whose blocks run pipeline-parallel over the
+    ``pipe`` mesh axis. ``depth`` must divide evenly into ``n_stages``
+    (default: the mesh's pipe-axis size)."""
+    if mesh is None or PIPELINE_AXIS not in mesh.shape:
+        raise FriendlyError(
+            "transformer_lm_pipelined needs a mesh with a "
+            f"'{PIPELINE_AXIS}' axis"
+        )
+    if d_model % heads:
+        raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
+    n_stages = n_stages or mesh.shape[PIPELINE_AXIS]
+    if n_stages != mesh.shape[PIPELINE_AXIS]:
+        raise FriendlyError(
+            f"n_stages {n_stages} != mesh '{PIPELINE_AXIS}' size "
+            f"{mesh.shape[PIPELINE_AXIS]}"
+        )
+    if depth % n_stages:
+        raise ParamError(
+            f"depth {depth} not divisible by {n_stages} pipeline stages"
+        )
+    if n_microbatches is not None and (
+        n_microbatches <= 0 or n_microbatches % n_stages
+    ):
+        raise ParamError(
+            f"n_microbatches {n_microbatches} must be a positive multiple "
+            f"of the pipeline depth {n_stages}"
+        )
+    d_ff = d_ff or 4 * d_model
+    stage = _Stage(depth // n_stages, heads, d_model // heads, d_ff, causal)
+    return PipelinedGraph(
+        name="transformer_lm_pipelined",
+        embed=TokenPosEmbed(vocab_size, d_model, max_len),
+        stage=stage,
+        head=LMHead(vocab_size),
+        n_stages=n_stages,
+        n_microbatches=n_microbatches or n_stages,
+        mesh=mesh,
+        input_shape=(max_len,),
+        extra={"vocab_size": vocab_size, "causal": causal},
+    )

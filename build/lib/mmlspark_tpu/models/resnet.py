@@ -1,0 +1,197 @@
+"""ResNet family (TPU-first: NHWC, bfloat16 compute, MXU-sized convs).
+
+Flagship inference model: ResNet-20 for CIFAR-10 — the model the reference's
+north-star notebook evaluates (notebooks/samples/301 - CIFAR10 CNTK CNN
+Evaluation.ipynb, `ConvNet_CIFAR10.model` via CNTKModel). ResNet-50 is the
+transfer-learning featurizer (notebooks 303/305, ModelDownloader "ResNet50"
+schema with ``layerNames`` cut points).
+
+Design notes (pallas_guide / scaling-book mental model):
+- NHWC layout end-to-end: XLA:TPU tiles the C dim onto lanes; channels are
+  kept multiples of 8 where practical.
+- compute in bfloat16, params + BN stats in float32 (Kaiming-style init).
+- No Python control flow on data; blocks are static — jit traces once.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph
+from mmlspark_tpu.models.registry import register_model
+
+
+class ConvBN(nn.Module):
+    """Conv + BatchNorm + optional ReLU, NHWC, bf16 compute."""
+
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    use_relu: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            self.strides,
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        )(x)
+        if self.use_relu:
+            x = nn.relu(x)
+        return x
+
+
+class ResBlock(nn.Module):
+    """Basic (2-conv) residual block."""
+
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = ConvBN(self.features, strides=self.strides, dtype=self.dtype)(x, train)
+        y = ConvBN(self.features, use_relu=False, dtype=self.dtype)(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features,
+                kernel=(1, 1),
+                strides=self.strides,
+                use_relu=False,
+                dtype=self.dtype,
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1-3-1 bottleneck block (ResNet-50 style)."""
+
+    features: int  # bottleneck width; output is 4x
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = ConvBN(self.features, kernel=(1, 1), dtype=self.dtype)(x, train)
+        y = ConvBN(self.features, strides=self.strides, dtype=self.dtype)(y, train)
+        y = ConvBN(
+            self.features * 4, kernel=(1, 1), use_relu=False, dtype=self.dtype
+        )(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features * 4,
+                kernel=(1, 1),
+                strides=self.strides,
+                use_relu=False,
+                dtype=self.dtype,
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class Stage(nn.Module):
+    """A stack of residual blocks at one resolution."""
+
+    block: Any
+    features: int
+    count: int
+    first_strides: tuple[int, int]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i in range(self.count):
+            strides = self.first_strides if i == 0 else (1, 1)
+            x = self.block(self.features, strides=strides, dtype=self.dtype)(
+                x, train
+            )
+        return x
+
+
+class GlobalPool(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return jnp.mean(x, axis=(1, 2))
+
+
+class Logits(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+class Stem(nn.Module):
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int]
+    max_pool: bool
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBN(
+            self.features, kernel=self.kernel, strides=self.strides, dtype=self.dtype
+        )(x, train)
+        if self.max_pool:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        return x
+
+
+@register_model("resnet20_cifar10")
+def resnet20_cifar10(num_classes: int = 10, width: int = 16) -> NamedGraph:
+    """ResNet-20 (3 stages x 3 basic blocks) for 32x32 inputs — the CIFAR-10
+    eval model of reference notebook 301."""
+    dt = jnp.bfloat16
+    blocks: list[tuple[str, Any]] = [
+        ("stem", Stem(width, (3, 3), (1, 1), max_pool=False, dtype=dt)),
+        ("stage1", Stage(ResBlock, width, 3, (1, 1), dtype=dt)),
+        ("stage2", Stage(ResBlock, width * 2, 3, (2, 2), dtype=dt)),
+        ("stage3", Stage(ResBlock, width * 4, 3, (2, 2), dtype=dt)),
+        ("pool", GlobalPool()),
+        (FINAL_NODE, Logits(num_classes, dtype=dt)),
+    ]
+    return NamedGraph(
+        name="resnet20_cifar10", blocks=blocks, input_shape=(32, 32, 3)
+    )
+
+
+@register_model("resnet50")
+def resnet50(num_classes: int = 1000, input_size: int = 224) -> NamedGraph:
+    """ResNet-50 (bottleneck 3-4-6-3) — the transfer-learning featurizer of
+    reference notebooks 303/305; cut at 'pool' for 2048-d features (the
+    layerNames/cutOutputLayers mechanism, ImageFeaturizer.scala:122)."""
+    dt = jnp.bfloat16
+    blocks: list[tuple[str, Any]] = [
+        ("stem", Stem(64, (7, 7), (2, 2), max_pool=True, dtype=dt)),
+        ("stage1", Stage(BottleneckBlock, 64, 3, (1, 1), dtype=dt)),
+        ("stage2", Stage(BottleneckBlock, 128, 4, (2, 2), dtype=dt)),
+        ("stage3", Stage(BottleneckBlock, 256, 6, (2, 2), dtype=dt)),
+        ("stage4", Stage(BottleneckBlock, 512, 3, (2, 2), dtype=dt)),
+        ("pool", GlobalPool()),
+        (FINAL_NODE, Logits(num_classes, dtype=dt)),
+    ]
+    return NamedGraph(
+        name="resnet50",
+        blocks=blocks,
+        input_shape=(input_size, input_size, 3),
+    )

@@ -1,0 +1,195 @@
+"""Transformer LM / encoder family with pluggable parallel attention.
+
+Capability upgrade beyond the reference (which has no attention anywhere —
+SURVEY.md §5): the long-context and multi-chip design the task requires.
+One model family covers:
+
+- single-chip dense attention (XLA-fused),
+- ring attention (context parallelism over the ``seq`` mesh axis),
+- Ulysses all-to-all sequence parallelism,
+
+selected by ``attn_impl`` — the module code is identical; only the
+attention call changes. Tensor parallelism comes from sharding rules
+(:data:`mmlspark_tpu.parallel.sharding.TRANSFORMER_TP_RULES`): layer names
+``qkv`` / ``attn_out`` / ``mlp_in`` / ``mlp_out`` are the contract those
+regexes match.
+
+Compute is bfloat16 (MXU-native), params float32, logits float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import ParamError
+from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph
+from mmlspark_tpu.models.registry import register_model
+from mmlspark_tpu.ops.attention import dense_attention
+
+DENSE = "dense"
+RING = "ring"
+ULYSSES = "ulysses"
+FLASH = "flash"
+AUTO = "auto"
+ATTN_IMPLS = (DENSE, RING, ULYSSES, FLASH, AUTO)
+
+
+def resolve_attn_impl(attn_impl: str) -> str:
+    """``auto`` -> the Pallas flash kernel on TPU (O(S·d) memory both
+    directions, ops/flash_attention.py), XLA dense elsewhere (the
+    interpreter-mode kernel would crawl on CPU test meshes)."""
+    if attn_impl != AUTO:
+        return attn_impl
+    import jax
+
+    return FLASH if jax.default_backend() == "tpu" else DENSE
+
+
+class TokenPosEmbed(nn.Module):
+    vocab_size: int
+    d_model: int
+    max_len: int
+
+    @nn.compact
+    def __call__(self, ids):
+        # ids: (B, T) int
+        tok = nn.Embed(self.vocab_size, self.d_model,
+                       param_dtype=jnp.float32, name="token")(ids)
+        pos = self.param(
+            "pos", nn.initializers.normal(0.02),
+            (self.max_len, self.d_model), jnp.float32,
+        )
+        return tok + pos[None, : ids.shape[1]]
+
+
+class SelfAttention(nn.Module):
+    heads: int
+    head_dim: int
+    causal: bool
+    attn_impl: str = DENSE
+    mesh: Any = None  # jax.sharding.Mesh (hashable -> valid static attr)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        h, d = self.heads, self.head_dim
+        x = x.astype(self.dtype)
+        qkv = nn.Dense(3 * h * d, dtype=self.dtype,
+                       param_dtype=jnp.float32, name="qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3 * h, d), 3, axis=2)
+        if self.attn_impl not in ATTN_IMPLS:
+            raise ParamError(
+                f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
+            )
+        impl = resolve_attn_impl(self.attn_impl)
+        if impl == FLASH:
+            from mmlspark_tpu.ops.flash_attention import flash_attention
+
+            o = flash_attention(q, k, v, causal=self.causal)
+        elif impl == DENSE or self.mesh is None:
+            # ring/ulysses degrade to dense when no mesh is provided
+            o = dense_attention(q, k, v, causal=self.causal)
+        elif impl == RING:
+            from mmlspark_tpu.parallel.context_parallel import ring_attention
+
+            o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+        elif impl == ULYSSES:
+            from mmlspark_tpu.parallel.context_parallel import (
+                ulysses_attention,
+            )
+
+            o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)
+        else:  # unreachable: impl validated + resolved above
+            raise ParamError(f"unhandled attn_impl '{impl}'")
+        return nn.Dense(x.shape[-1], dtype=self.dtype,
+                        param_dtype=jnp.float32, name="attn_out")(
+            o.reshape(b, t, h * d)
+        )
+
+
+class Block(nn.Module):
+    heads: int
+    head_dim: int
+    d_ff: int
+    causal: bool
+    attn_impl: str
+    mesh: Any
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + SelfAttention(
+            self.heads, self.head_dim, self.causal, self.attn_impl,
+            self.mesh, self.dtype, name="attn",
+        )(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = nn.Dense(self.d_ff, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_in")(y.astype(self.dtype))
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(y)
+        return x + y
+
+
+class LMHead(nn.Module):
+    vocab_size: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.Dense(self.vocab_size, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("transformer_lm")
+def transformer_lm(
+    vocab_size: int = 1024,
+    d_model: int = 128,
+    heads: int = 4,
+    depth: int = 2,
+    d_ff: int = 0,
+    max_len: int = 512,
+    causal: bool = True,
+    attn_impl: str = AUTO,
+    mesh: Any = None,
+) -> NamedGraph:
+    """Decoder-only LM (or bidirectional encoder with ``causal=False``);
+    per-token logits, so it also serves as the long-context sequence
+    tagger (the BiLSTM capability, scaled)."""
+    if d_model % heads:
+        raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
+    if attn_impl not in ATTN_IMPLS:
+        raise ParamError(
+            f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
+        )
+    attn_impl = resolve_attn_impl(attn_impl)
+    d_ff = d_ff or 4 * d_model
+    blocks: list[tuple[str, Any]] = [
+        ("embed", TokenPosEmbed(vocab_size, d_model, max_len))
+    ]
+    for i in range(depth):
+        blocks.append(
+            (
+                f"block{i}",
+                Block(heads, d_model // heads, d_ff, causal, attn_impl,
+                      mesh),
+            )
+        )
+    blocks.append((FINAL_NODE, LMHead(vocab_size)))
+    return NamedGraph(
+        name="transformer_lm",
+        blocks=blocks,
+        input_shape=(max_len,),
+        extra={
+            "vocab_size": vocab_size,
+            "attn_impl": attn_impl,
+            "causal": causal,
+        },
+    )

@@ -1,0 +1,7 @@
+"""Ops: native host-side kernels (image decode) and device-side image ops.
+
+The reference's L0 native layer (SURVEY.md §2.10) split across:
+- :mod:`mmlspark_tpu.ops.decode` — C++ decode op (OpenCV-imdecode equivalent)
+- :mod:`mmlspark_tpu.ops.image_ops` — vectorized NHWC ops on device (the
+  OpenCV geometric/filter ops re-expressed as XLA-compilable JAX functions)
+"""

@@ -1,0 +1,92 @@
+"""Attention math: dense reference implementation + the online-softmax
+block update shared by the ring (context-parallel) and flash paths.
+
+The reference has NO attention code at all (SURVEY.md §5 "long-context:
+absent" — its only sequence model is an opaque downloaded BiLSTM graph,
+notebook 304). Long-context support is a required capability *upgrade* for
+the TPU build, so this module is designed hardware-first rather than ported:
+scores accumulate in float32, the streaming-softmax update lets K/V arrive
+in blocks (from a ring neighbor or a VMEM tile) without materializing the
+full (S, S) score matrix, and every shape is static for XLA.
+
+Layout convention: ``(batch, seq, heads, head_dim)`` for q/k/v, running
+stats ``(batch, heads, q_len)``, accumulator ``(batch, q_len, heads, dim)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def causal_block_mask(q_len: int, kv_len: int, q_offset, kv_offset):
+    """Additive mask (q_len, kv_len) for a block of a causal attention
+    matrix whose global coordinates start at (q_offset, kv_offset).
+
+    Offsets may be traced scalars (ring steps compute the kv offset from
+    the rotating source index) — only the lengths must be static.
+    """
+    qi = q_offset + jnp.arange(q_len)[:, None]
+    kj = kv_offset + jnp.arange(kv_len)[None, :]
+    return jnp.where(kj > qi, NEG_INF, 0.0).astype(jnp.float32)
+
+
+def softmax_block_update(carry, q, k, v, scale, mask=None):
+    """One streaming-softmax step: fold the (k, v) block into the running
+    (max, normalizer, accumulator) for queries ``q``.
+
+    ``carry = (m, l, acc)`` with m, l: (B, H, Q) float32 and
+    acc: (B, Q, H, D) float32. Blocks where every entry is masked
+    contribute exactly zero (the -inf running max is substituted before
+    exponentiation, never subtracted from itself).
+    """
+    m, l, acc = carry
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+    if mask is not None:
+        s = s + mask  # broadcast (Q, K) or (B, H, Q, K)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # rows still at -inf (nothing unmasked yet): exponentiate against 0
+    # so exp(-inf - 0) == 0 instead of exp(-inf + inf) == nan
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    corr = jnp.exp(m - m_safe)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * jnp.moveaxis(corr, 1, 2)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def finalize_softmax(l, acc, dtype):
+    """Normalize the accumulator; fully-masked rows come out as zeros."""
+    denom = jnp.moveaxis(jnp.where(l == 0.0, 1.0, l), 1, 2)[..., None]
+    return (acc / denom).astype(dtype)
+
+
+def dense_attention(q, k, v, *, causal: bool = False, scale=None,
+                    q_offset: int = 0, kv_offset: int = 0):
+    """Reference multi-head attention, (B, S, H, D) layout.
+
+    Single fused einsum-softmax-einsum — exactly what XLA fuses well on one
+    chip; the parallel layer (:mod:`mmlspark_tpu.parallel.context_parallel`)
+    decomposes the same math across devices and must match this output.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        s = s + causal_block_mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+    m = s.max(axis=-1, keepdims=True)
+    m = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    denom = p.sum(axis=-1)
+    denom = jnp.moveaxis(jnp.where(denom == 0.0, 1.0, denom), 1, 2)[..., None]
+    return (out / denom).astype(q.dtype)

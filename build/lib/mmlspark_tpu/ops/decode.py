@@ -1,0 +1,82 @@
+"""Image decode: encoded bytes -> HWC uint8 BGR array.
+
+Equivalent of reference ``ImageReader.decode``
+(readers/src/main/scala/ImageReader.scala:45-63): OpenCV ``imdecode`` behind
+JNI, always producing 3-channel BGR CV_8U; decode failure -> row dropped.
+
+Primary path is the C++ op (mmlspark_tpu/ops/native/decode.cpp, via ctypes);
+fallback is PIL (decodes RGB, converted to BGR here) so the framework works
+without a toolchain — the native path is the production one.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.ops import native_build
+
+_log = get_logger("decode")
+
+
+def _decode_native(data: bytes) -> np.ndarray | None:
+    lib = native_build.load_library()
+    if lib is None:
+        return None
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    rc = lib.mml_decode_image(
+        data, len(data), ctypes.byref(h), ctypes.byref(w), ctypes.byref(c),
+        ctypes.byref(out),
+    )
+    if rc != 0:
+        return None
+    try:
+        n = h.value * w.value * c.value
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+        return arr.reshape(h.value, w.value, c.value)
+    finally:
+        lib.mml_free(out)
+
+
+def _decode_pil(data: bytes) -> np.ndarray | None:
+    try:
+        from PIL import Image
+    except ImportError:  # pragma: no cover
+        return None
+    try:
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        rgb = np.asarray(img, dtype=np.uint8)
+        return rgb[:, :, ::-1].copy()  # RGB -> BGR
+    except Exception:
+        return None
+
+
+def decode_image(data: bytes) -> np.ndarray | None:
+    """Decode to (H, W, 3) uint8 BGR, or None for non-decodable input (the
+    caller drops the row, mirroring ImageReader.decode => None)."""
+    if not isinstance(data, (bytes, bytearray)) or len(data) < 8:
+        return None
+    out = _decode_native(bytes(data))
+    if out is None:
+        # Fall back to PIL for formats the native op doesn't cover (GIF,
+        # TIFF, WebP, CMYK JPEG, ...) so row counts do not depend on
+        # whether a toolchain was available.
+        out = _decode_pil(bytes(data))
+    return out
+
+
+def native_available() -> bool:
+    return native_build.load_library() is not None
+
+
+def encode_ppm(arr: np.ndarray) -> bytes:
+    """Tiny BGR->PPM encoder used by tests/fixtures (no native dep)."""
+    h, w, _ = arr.shape
+    header = f"P6\n{w} {h}\n255\n".encode()
+    return header + arr[:, :, ::-1].astype(np.uint8).tobytes()

@@ -1,0 +1,372 @@
+"""Flash attention as Pallas TPU kernels — forward AND backward.
+
+The hot op the reference never had (no attention code exists in the
+reference tree — SURVEY.md §5): blockwise streaming-softmax attention that
+keeps the running (max, normalizer, accumulator) in VMEM scratch across the
+K-block grid dimension, so the (S, S) score matrix never hits HBM. Q/K/V
+tiles stream HBM→VMEM via the grid BlockSpecs; scores and the P·V matmul
+run on the MXU in float32 accumulation.
+
+Backward pass (FlashAttention-2 recipe): the forward additionally emits the
+per-row log-sum-exp (lanes-replicated, the same layout trick as the
+reference pallas kernel in jax.experimental.pallas.ops.tpu.flash_attention),
+and two Pallas kernels recompute P blockwise from (Q, K, LSE) —
+
+  - dK/dV kernel: grid (batch·heads, k-block, q-block), accumulating
+    ``dV += Pᵀ·dO`` and ``dK += dSᵀ·Q`` in VMEM scratch over the q dim;
+  - dQ kernel: grid (batch·heads, q-block, k-block), accumulating
+    ``dQ += dS·K`` over the k dim;
+
+with ``dS = P ⊙ (dO·Vᵀ − D)`` and ``D = rowsum(dO ⊙ O)`` precomputed in
+XLA. Memory stays O(S·d) end to end — nothing (S, S) is ever materialized
+in either direction.
+
+Off-TPU (the unit-test CPU mesh) the kernels run in interpreter mode, so
+the same code path is tested everywhere.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite: -inf minus -inf would poison the running max
+LANES = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, blk: int, seq_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: K blocks fully above the diagonal contribute nothing
+    live = (ki * blk <= qi * blk + blk - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (blk, blk)
+        kpos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        pad_mask = kpos >= seq_len  # padded keys never attend
+        if causal:
+            qpos = qi * blk + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            pad_mask = pad_mask | (kpos > qpos)
+        s = jnp.where(pad_mask, NEG_INF, s)
+
+        m_prev = m_scr[:, :1]  # (blk, 1), lanes replicated
+        m_cur = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+        # log-sum-exp residual for the backward; padded rows (l == 0)
+        # get NEG_INF so recomputed p vanishes there
+        lse_ref[0] = jnp.where(
+            l_scr[:] == 0.0,
+            NEG_INF,
+            m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])),
+        )
+
+
+def _to_bh(t, s_pad):
+    b, s, h, d = t.shape
+    t = jnp.moveaxis(t, 2, 1).reshape(b * h, s, d)
+    if s_pad != s:
+        t = jnp.pad(t, ((0, 0), (0, s_pad - s), (0, 0)))
+    return t
+
+
+def _from_bh(t, b, h, s):
+    return jnp.moveaxis(t[:, :s].reshape(b, h, s, -1), 1, 2)
+
+
+def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
+                   interpret: bool):
+    b, s, h, d = q.shape
+    blk = min(block, _round_up(s, 8))
+    s_pad = _round_up(s, blk)
+    qb, kb, vb = (_to_bh(t, s_pad) for t in (q, k, v))
+    n_blk = s_pad // blk
+    grid = (b * h, n_blk, n_blk)
+    tile = lambda im: pl.BlockSpec((1, blk, d), im,
+                                   memory_space=pltpu.VMEM)
+    lse_tile = pl.BlockSpec((1, blk, LANES), lambda bh, i, j: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
+    out, lse = pl.pallas_call(
+        partial(_fwd_kernel, scale=scale, causal=causal, blk=blk,
+                seq_len=s),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, LANES), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            tile(lambda bh, i, j: (bh, i, 0)),  # Q: row block
+            tile(lambda bh, i, j: (bh, j, 0)),  # K: column block
+            tile(lambda bh, i, j: (bh, j, 0)),  # V: column block
+        ],
+        out_specs=(tile(lambda bh, i, j: (bh, i, 0)), lse_tile),
+        scratch_shapes=[
+            pltpu.VMEM((blk, LANES), jnp.float32),  # running max
+            pltpu.VMEM((blk, LANES), jnp.float32),  # running normalizer
+            pltpu.VMEM((blk, d), jnp.float32),      # accumulator
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    return _from_bh(out, b, h, s), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, scale, causal, blk,
+                 seq_len):
+    """Rebuild the (blk_q, blk_k) probability block from Q, K and the saved
+    row log-sum-exp; masked/padded entries come back exactly zero."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    lse = lse_ref[0][:, :1]  # (blk, 1), lanes replicated
+    p = jnp.exp(s - lse)
+    kpos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    qpos = qi * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    dead = (kpos >= seq_len) | (qpos >= seq_len)
+    if causal:
+        dead = dead | (kpos > qpos)
+    return jnp.where(dead, 0.0, p)
+
+
+def _bwd_kv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                   dk_ref, dv_ref, dk_scr, dv_scr, *,
+                   scale: float, causal: bool, blk: int, seq_len: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (kj * blk <= qi * blk + blk - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, scale=scale,
+                         causal=causal, blk=blk, seq_len=seq_len)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        # dV += Pᵀ · dO
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dS = P ⊙ (dO·Vᵀ − D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0][:, :1])
+        # dK += dSᵀ · Q · scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
+                  dq_ref, dq_scr, *,
+                  scale: float, causal: bool, blk: int, seq_len: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (kj * blk <= qi * blk + blk - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, scale=scale,
+                         causal=causal, blk=blk, seq_len=seq_len)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0][:, :1])
+        # dQ += dS · K · scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
+                    block: int, interpret: bool):
+    b, s, h, d = q.shape
+    blk = min(block, _round_up(s, 8))
+    s_pad = _round_up(s, blk)
+    qb, kb, vb, dob = (_to_bh(t, s_pad) for t in (q, k, v, g))
+    # D = rowsum(dO ⊙ O): (bh, s_pad), lanes-replicated like the LSE
+    dd = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (b, s, h)
+    dd = jnp.moveaxis(dd, 2, 1).reshape(b * h, s)
+    if s_pad != s:
+        dd = jnp.pad(dd, ((0, 0), (0, s_pad - s)))
+    dd = jnp.broadcast_to(dd[:, :, None], (b * h, s_pad, LANES))
+
+    n_blk = s_pad // blk
+    tile = lambda im: pl.BlockSpec((1, blk, d), im,
+                                   memory_space=pltpu.VMEM)
+    rep = lambda im: pl.BlockSpec((1, blk, LANES), im,
+                                  memory_space=pltpu.VMEM)
+
+    # dK / dV: fix the k block, stream q blocks (qi is the fastest grid dim)
+    dkb, dvb = pl.pallas_call(
+        partial(_bwd_kv_kernel, scale=scale, causal=causal, blk=blk,
+                seq_len=s),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
+        ),
+        grid=(b * h, n_blk, n_blk),
+        in_specs=[
+            tile(lambda bh, j, i: (bh, i, 0)),  # Q
+            tile(lambda bh, j, i: (bh, i, 0)),  # dO
+            rep(lambda bh, j, i: (bh, i, 0)),   # LSE
+            rep(lambda bh, j, i: (bh, i, 0)),   # D
+            tile(lambda bh, j, i: (bh, j, 0)),  # K
+            tile(lambda bh, j, i: (bh, j, 0)),  # V
+        ],
+        out_specs=(
+            tile(lambda bh, j, i: (bh, j, 0)),
+            tile(lambda bh, j, i: (bh, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, dob, lse, dd, kb, vb)
+
+    # dQ: fix the q block, stream k blocks (kj fastest)
+    dqb = pl.pallas_call(
+        partial(_bwd_q_kernel, scale=scale, causal=causal, blk=blk,
+                seq_len=s),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+        grid=(b * h, n_blk, n_blk),
+        in_specs=[
+            tile(lambda bh, i, j: (bh, j, 0)),  # K
+            tile(lambda bh, i, j: (bh, j, 0)),  # V
+            tile(lambda bh, i, j: (bh, i, 0)),  # Q
+            tile(lambda bh, i, j: (bh, i, 0)),  # dO
+            rep(lambda bh, i, j: (bh, i, 0)),   # LSE
+            rep(lambda bh, i, j: (bh, i, 0)),   # D
+        ],
+        out_specs=tile(lambda bh, i, j: (bh, i, 0)),
+        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        interpret=interpret,
+    )(kb, vb, qb, dob, lse, dd)
+
+    unpack = lambda t: _from_bh(t, b, h, s)
+    return unpack(dqb), unpack(dkb), unpack(dvb)
+
+
+# ---------------------------------------------------------------------------
+# public op
+
+
+@lru_cache(maxsize=None)
+def _build(causal: bool, scale_key, block: int, interpret: bool):
+    @jax.custom_vjp
+    def f(q, k, v):
+        scale = scale_key if scale_key else q.shape[-1] ** -0.5
+        out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
+                                block=block, interpret=interpret)
+        return out
+
+    def fwd(q, k, v):
+        scale = scale_key if scale_key else q.shape[-1] ** -0.5
+        out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
+                                  block=block, interpret=interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, out, lse = res
+        scale = scale_key if scale_key else q.shape[-1] ** -0.5
+        return _flash_backward(q, k, v, out, lse, g, causal=causal,
+                               scale=scale, block=block,
+                               interpret=interpret)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None,
+                    block: int = 128, interpret: bool | None = None):
+    """Blockwise fused attention, (B, S, H, D) layout, exact output AND
+    exact gradients — both directions O(S·d) memory.
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, interpreter
+    elsewhere (tests). Sequences are padded to the block size internally;
+    padded keys are masked, padded query rows are sliced away.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _build(causal, scale, block, bool(interpret))(q, k, v)

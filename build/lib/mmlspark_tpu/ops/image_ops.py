@@ -1,0 +1,155 @@
+"""Image ops: OpenCV-semantics transforms, host (per-row numpy) and device
+(batched NHWC jax) paths.
+
+The reference runs OpenCV ops inside a per-row UDF via JNI
+(image-transformer/src/main/scala/ImageTransformer.scala:21-252:
+resize/crop/colorFormat/blur/threshold/gaussianKernel/flip on BGR CV_8U
+Mats). Here every op has:
+
+- a numpy implementation on one HWC uint8 BGR image (exact, handles
+  per-image sizes), used by ImageTransformer for ragged inputs, and
+- where it matters for the hot path, a jax NHWC batch implementation that
+  XLA fuses on device (resize for the featurizer feed).
+
+Threshold type codes mirror OpenCV: binary, binary_inv, trunc, tozero,
+tozero_inv. Flip codes mirror OpenCV: 0 = vertical (up/down), 1 =
+horizontal (left/right), -1 = both.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+
+# -- host (single image, HWC uint8) -----------------------------------------
+
+
+def resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear resize (OpenCV INTER_LINEAR default semantics)."""
+    import jax
+
+    out = jax.image.resize(
+        img.astype(np.float32),
+        (height, width, img.shape[2]),
+        method="bilinear",
+    )
+    return np.clip(np.asarray(out), 0, 255).round().astype(np.uint8)
+
+
+def crop(img: np.ndarray, x: int, y: int, height: int, width: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    if y + height > h or x + width > w or x < 0 or y < 0:
+        raise FriendlyError(
+            f"crop ({x},{y},{height},{width}) outside image {h}x{w}"
+        )
+    return img[y : y + height, x : x + width]
+
+
+def color_format(img: np.ndarray, format: str) -> np.ndarray:
+    """'gray' via OpenCV BGR weights; 'bgr' passthrough."""
+    if format == "bgr":
+        return img
+    if format == "gray":
+        b, g, r = img[..., 0], img[..., 1], img[..., 2]
+        gray = 0.114 * b + 0.587 * g + 0.299 * r
+        return np.clip(gray, 0, 255).round().astype(np.uint8)[..., None]
+    raise FriendlyError(f"unknown color format '{format}'")
+
+
+def _box_kernel(ky: int, kx: int) -> np.ndarray:
+    return np.full((ky, kx), 1.0 / (ky * kx))
+
+
+def _conv2d_same(img: np.ndarray, kernel: np.ndarray) -> np.ndarray:
+    """Depthwise 2-D convolution, reflect-101 border (OpenCV default)."""
+    ky, kx = kernel.shape
+    py, px = ky // 2, kx // 2
+    out = np.empty_like(img, dtype=np.float64)
+    for c in range(img.shape[2]):
+        padded = np.pad(
+            img[..., c].astype(np.float64), ((py, py), (px, px)), mode="reflect"
+        )
+        acc = np.zeros(img.shape[:2], dtype=np.float64)
+        for dy in range(ky):
+            for dx in range(kx):
+                acc += kernel[dy, dx] * padded[
+                    dy : dy + img.shape[0], dx : dx + img.shape[1]
+                ]
+        out[..., c] = acc
+    return np.clip(out, 0, 255).round().astype(np.uint8)
+
+
+def blur(img: np.ndarray, ky: int, kx: int) -> np.ndarray:
+    """Normalized box blur (OpenCV blur)."""
+    return _conv2d_same(img, _box_kernel(int(ky), int(kx)))
+
+
+def gaussian_kernel(img: np.ndarray, aperture: int, sigma: float) -> np.ndarray:
+    """Gaussian filter (OpenCV GaussianBlur/filter2D w/ getGaussianKernel)."""
+    n = int(aperture)
+    if sigma <= 0:
+        sigma = 0.3 * ((n - 1) * 0.5 - 1) + 0.8  # OpenCV default sigma rule
+    ax = np.arange(n) - (n - 1) / 2.0
+    g = np.exp(-(ax**2) / (2 * sigma**2))
+    g /= g.sum()
+    return _conv2d_same(img, np.outer(g, g))
+
+
+THRESHOLD_TYPES = ("binary", "binary_inv", "trunc", "tozero", "tozero_inv")
+
+
+def threshold(
+    img: np.ndarray, thresh: float, max_val: float, kind: str = "binary"
+) -> np.ndarray:
+    f = img.astype(np.float64)
+    if kind == "binary":
+        out = np.where(f > thresh, max_val, 0.0)
+    elif kind == "binary_inv":
+        out = np.where(f > thresh, 0.0, max_val)
+    elif kind == "trunc":
+        out = np.minimum(f, thresh)
+    elif kind == "tozero":
+        out = np.where(f > thresh, f, 0.0)
+    elif kind == "tozero_inv":
+        out = np.where(f > thresh, 0.0, f)
+    else:
+        raise FriendlyError(
+            f"unknown threshold type '{kind}'; one of {THRESHOLD_TYPES}"
+        )
+    return np.clip(out, 0, 255).round().astype(np.uint8)
+
+
+def flip(img: np.ndarray, code: int = 1) -> np.ndarray:
+    """OpenCV flip codes: 0 vertical, positive horizontal, negative both."""
+    if code == 0:
+        return img[::-1]
+    if code > 0:
+        return img[:, ::-1]
+    return img[::-1, ::-1]
+
+
+# -- device (batched NHWC) ---------------------------------------------------
+
+
+def batch_resize_nhwc(batch, height: int, width: int):
+    """Bilinear resize of an NHWC batch on device (jit/XLA path — the
+    featurizer's resize-to-model-input feed)."""
+    import jax
+
+    n, _, _, c = batch.shape
+    return jax.image.resize(
+        batch, (n, height, width, c), method="bilinear"
+    )
+
+
+def batch_normalize_nhwc(batch, mean=None, std=None, scale=1.0 / 255.0):
+    """uint8 NHWC -> float32 normalized (fused with the model by XLA)."""
+    import jax.numpy as jnp
+
+    x = batch.astype(jnp.float32) * scale
+    if mean is not None:
+        x = x - jnp.asarray(mean, jnp.float32)
+    if std is not None:
+        x = x / jnp.asarray(std, jnp.float32)
+    return x

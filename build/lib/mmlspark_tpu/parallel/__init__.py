@@ -1,0 +1,40 @@
+"""Parallelism layer: device meshes, shardings, context parallelism,
+multi-host init.
+
+One backend replaces the reference's four transports (SURVEY.md §5
+"distributed communication backend": Spark RPC/broadcast/shuffle, MPI,
+py4j, JNI): single-controller JAX with XLA collectives compiled onto ICI
+within a slice and DCN across slices. Beyond reference parity it adds
+tensor parallelism (sharding rules) and sequence/context parallelism
+(ring attention, Ulysses all-to-all) — first-class for the TPU build.
+"""
+
+from mmlspark_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    batch_spec,
+    initialize_distributed,
+    make_mesh,
+    replicated_spec,
+)
+from mmlspark_tpu.parallel.pipeline import (  # noqa: F401
+    PIPELINE_STAGE_RULES,
+    pipeline_apply,
+)
+from mmlspark_tpu.parallel.expert import (  # noqa: F401
+    EXPERT_RULES,
+    moe_ffn,
+)
+from mmlspark_tpu.parallel.context_parallel import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
+from mmlspark_tpu.parallel.sharding import (  # noqa: F401
+    TRANSFORMER_TP_RULES,
+    build_param_shardings,
+    shard_params,
+    spec_for_path,
+)

@@ -1,0 +1,148 @@
+"""Sequence/context parallelism: ring attention and all-to-all (Ulysses).
+
+The reference scales sequence length by not scaling it (SURVEY.md §5: no
+ring attention, context parallel, or Ulysses anywhere; pad-to-max in
+notebook UDFs). For the TPU build long context is first-class: sequences
+shard over a mesh axis and attention runs either
+
+- **ring**: K/V blocks rotate around the ``seq`` axis with
+  ``lax.ppermute`` (one ICI hop per step) while each device folds the
+  visiting block into a streaming softmax — memory per device stays
+  O(S/n · S/n) and the full (S, S) matrix never exists anywhere; or
+- **ulysses**: two ``lax.all_to_all`` collectives re-shard from
+  sequence-sharded to head-sharded, run ordinary dense attention on full
+  sequences for H/n local heads, and shard back.
+
+Both are exact (they must equal :func:`dense_attention` bit-for-bit up to
+float tolerance — tested), differentiable (scan + collectives transpose
+cleanly), and compose with data parallelism: the batch dimension stays on
+the ``data`` axis throughout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.ops.attention import (
+    NEG_INF,
+    causal_block_mask,
+    dense_attention,
+    finalize_softmax,
+    softmax_block_update,
+)
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+
+
+def _ring_inner(q, k, v, *, axis_name: str, causal: bool, scale):
+    """Per-shard ring attention body (runs under shard_map).
+
+    q, k, v: local sequence chunks (B, S/n, H, D). Chunk ownership after
+    ``step`` rotations: device i holds K/V chunk (i - step) mod n, which
+    gives the global kv offset for causal masking.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(carry, step):
+        m, l, acc, kc, vc = carry
+        src = (idx - step) % n
+        mask = (
+            causal_block_mask(sq, sk, idx * sq, src * sk) if causal else None
+        )
+        m, l, acc = softmax_block_update((m, l, acc), q, kc, vc, scale, mask)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m, l, acc, kc, vc), ()
+
+    (m, l, acc, _, _), _ = lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    return finalize_softmax(l, acc, q.dtype)
+
+
+def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool, scale):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern): trade
+    the sequence sharding for head sharding, attend densely, trade back."""
+    a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    # (B, S/n, H, D) -> (B, S, H/n, D): split heads, concat sequence
+    q, k, v = (a2a(t, split_axis=2, concat_axis=1) for t in (q, k, v))
+    o = dense_attention(q, k, v, causal=causal, scale=scale)
+    # back to sequence-sharded layout
+    return a2a(o, split_axis=1, concat_axis=2)
+
+
+def _sharded_call(inner, q, k, v, mesh, axis: str, batch_axis: str):
+    # shard the batch dim too when it divides evenly (dp × sp); otherwise
+    # (e.g. the single-example init trace) replicate it within the map
+    batch = (
+        batch_axis
+        if batch_axis in mesh.shape and q.shape[0] % mesh.shape[batch_axis] == 0
+        else None
+    )
+    spec = P(batch, axis, None, None)
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ring_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
+                   causal: bool = False, scale=None,
+                   batch_axis: str = DATA_AXIS):
+    """Exact attention with q/k/v sharded on ``axis`` over ``mesh``.
+
+    Works inside or outside an enclosing ``jit``; XLA reshards inputs to
+    the sequence layout if they arrive otherwise.
+    """
+    _check(mesh, axis, q.shape[1], "ring")
+    inner = partial(_ring_inner, axis_name=axis, causal=causal, scale=scale)
+    return _sharded_call(inner, q, k, v, mesh, axis, batch_axis)
+
+
+def ulysses_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
+                      causal: bool = False, scale=None,
+                      batch_axis: str = DATA_AXIS):
+    """All-to-all sequence-parallel attention; heads must divide by the
+    axis size (each device attends H/n full-length heads)."""
+    n = _check(mesh, axis, q.shape[1], "ulysses")
+    if q.shape[2] % n:
+        raise FriendlyError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
+            f"'{axis}' ({n})"
+        )
+    inner = partial(_ulysses_inner, axis_name=axis, causal=causal,
+                    scale=scale)
+    return _sharded_call(inner, q, k, v, mesh, axis, batch_axis)
+
+
+def _check(mesh, axis: str, seq_len: int, what: str) -> int:
+    if axis not in mesh.shape:
+        raise FriendlyError(
+            f"{what} attention needs axis '{axis}' in the mesh; "
+            f"mesh axes: {dict(mesh.shape)}"
+        )
+    n = mesh.shape[axis]
+    if seq_len % n:
+        raise FriendlyError(
+            f"{what} attention needs sequence length ({seq_len}) divisible "
+            f"by mesh axis '{axis}' ({n})"
+        )
+    return n

@@ -1,0 +1,157 @@
+"""Expert parallelism: sparse mixture-of-experts dispatch over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.5 — data parallelism is the
+reference's only strategy); expert parallelism is part of the first-class
+distributed design the TPU build adds.
+
+Design (the standard TPU MoE recipe — Switch/GShard style, expressed with
+GSPMD rather than hand-written all-to-alls):
+
+- expert FFN params are *stacked* on a leading dim of size ``n_experts``
+  and sharded over the ``expert`` mesh axis (rule set
+  :data:`EXPERT_RULES`) — each device group holds ``n_experts / E`` experts;
+- routing is top-k softmax gating with capacity-bounded dispatch: tokens
+  are scattered into a ``(n_experts, capacity, d)`` buffer via one-hot
+  matmuls (MXU-friendly — no dynamic shapes, no sorts inside jit),
+  experts run as one batched ``einsum`` over the stacked dim, and results
+  gather back weighted by the gate probabilities;
+- with the dispatch tensor sharded ``(expert, None, None)`` and token
+  activations sharded on ``data``, GSPMD compiles the scatter/gather into
+  the all-to-alls over ICI — the collectives are derived, not written;
+- tokens overflowing an expert's capacity are dropped (standard Switch
+  behavior); the residual connection keeps dropped tokens lossless in the
+  block output.
+
+Everything is fixed-shape and differentiable; the auxiliary load-balancing
+loss (Switch §2.2 form: ``n_experts * Σ_e f_e · p_e``) is returned alongside
+the output for the trainer to add.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import ParamError
+from mmlspark_tpu.parallel.mesh import EXPERT_AXIS
+
+#: param-sharding rules placing the stacked expert dim on the ``expert``
+#: mesh axis (leading dim of every leaf under an ``experts`` module).
+EXPERT_RULES: list[tuple[str, tuple]] = [
+    (r"/experts/", (EXPERT_AXIS,)),
+]
+
+
+def router_probs(x, gate_w):
+    """Softmax router over experts. x: (B, T, D); gate_w: (D, E)."""
+    # float32 routing regardless of compute dtype: gate decisions are
+    # precision-sensitive
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def moe_dispatch(probs, capacity: int, mask=None):
+    """Build dispatch/combine tensors from router probabilities.
+
+    probs: (N, E) per-token expert probabilities (tokens already flattened);
+    mask: optional (N,) 0/1 real-token mask — padding tokens route nowhere,
+    consume no expert capacity, and are excluded from the balance loss
+    (the primary loss masks them too, trainer.masked_loss).
+    Returns ``(dispatch, combine, aux_loss)`` where dispatch is a boolean
+    (N, E, C) scatter mask, combine is its gate-weighted float version, and
+    aux_loss is the Switch load-balancing loss.
+    """
+    n, e = probs.shape
+    expert = jnp.argmax(probs, axis=-1)  # top-1 routing
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
+    if mask is not None:
+        onehot = onehot * mask.astype(jnp.float32)[:, None]
+    # position of each token within its expert's queue (exclusive cumsum)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # (N, E)
+    kept = (pos < capacity) * onehot  # overflow tokens dropped
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)  # (N, E, C)
+    dispatch = kept[..., None] * slot  # (N, E, C)
+    gate = (probs * kept).sum(-1)  # chosen-expert prob, 0 when dropped
+    combine = dispatch * gate[:, None, None]
+    # Switch load-balance loss over real tokens: routed fraction vs mean
+    # router prob
+    n_real = jnp.maximum(onehot.sum(), 1.0)
+    frac = onehot.sum(0) / n_real
+    if mask is not None:
+        w = mask.astype(jnp.float32)[:, None]
+        mean_prob = (probs * w).sum(0) / jnp.maximum(w.sum(), 1.0)
+    else:
+        mean_prob = probs.mean(0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, gate_w, w_in, b_in, w_out, b_out, *,
+            capacity_factor: float = 1.25, mask=None,
+            group_size: int = 1024):
+    """Top-1 switch FFN. x: (B, T, D); w_in: (E, D, F); w_out: (E, F, D);
+    mask: optional (B,) real-row mask (padding rows route nowhere).
+
+    Tokens route in fixed-size groups (the GShard/Switch recipe): capacity
+    is bounded per group, so the (G, S, E, C) dispatch/combine tensors stay
+    LINEAR in the token count instead of quadratic — the all-token variant
+    would be O(N²) memory and overflow HBM at production batch×seq.
+
+    Returns (out, aux_loss). Compute dtype follows ``x``; routing and the
+    dispatch einsums run float32.
+    """
+    b, t, d = x.shape
+    e = w_in.shape[0]
+    n = b * t
+    flat = x.reshape(n, d)
+    tok_mask = (
+        jnp.repeat(mask.astype(jnp.float32), t)
+        if mask is not None
+        else jnp.ones(n, jnp.float32)
+    )
+    # pad the token dim up to a multiple of the group size: masked padding
+    # tokens route nowhere and consume no capacity, so group size stays at
+    # the target for ANY batch x seq shape (a divisor-of-n scheme
+    # degenerates to 1-token groups when n is prime, making the capacity
+    # bound vacuous)
+    s = min(group_size, n)
+    pad = (-n) % s
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+        tok_mask = jnp.pad(tok_mask, (0, pad))
+    g = (n + pad) // s
+    capacity = max(int(capacity_factor * s / e), 1)
+    probs = router_probs(flat, gate_w).reshape(g, s, e)
+    gmask = tok_mask.reshape(g, s)
+    dispatch, combine, aux = jax.vmap(
+        lambda p, m: moe_dispatch(p, capacity, m)
+    )(probs, gmask)
+    aux = aux.mean()
+    grouped = flat.reshape(g, s, d)
+    # scatter: (G, S, E, C) × (G, S, D) -> (G, E, C, D); sharded over
+    # `expert`, GSPMD turns this into the dispatch all-to-all
+    buf = jnp.einsum("gsec,gsd->gecd", dispatch,
+                     grouped.astype(jnp.float32)).astype(x.dtype)
+    h = jnp.einsum("gecd,edf->gecf", buf, w_in.astype(x.dtype))
+    h = jax.nn.gelu(h + b_in[None, :, None, :].astype(x.dtype))
+    y = jnp.einsum("gecf,efd->gecd", h, w_out.astype(x.dtype))
+    y = y + b_out[None, :, None, :].astype(x.dtype)
+    # gather back, gate-weighted; drop the padding tokens
+    out = jnp.einsum("gsec,gecd->gsd", combine, y.astype(jnp.float32))
+    out = out.reshape((n + pad), d)[:n]
+    return out.reshape(b, t, d).astype(x.dtype), aux
+
+
+def validate_experts(n_experts: int, mesh=None) -> None:
+    if n_experts < 2:
+        raise ParamError(f"need >= 2 experts, got {n_experts}")
+    if (
+        mesh is not None
+        and EXPERT_AXIS in mesh.shape
+        and n_experts % mesh.shape[EXPERT_AXIS]
+    ):
+        raise ParamError(
+            f"n_experts {n_experts} not divisible by mesh axis "
+            f"'{EXPERT_AXIS}' ({mesh.shape[EXPERT_AXIS]})"
+        )

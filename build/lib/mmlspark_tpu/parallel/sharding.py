@@ -1,0 +1,85 @@
+"""Parameter sharding rules: param-path regex -> PartitionSpec.
+
+The reference has exactly one distribution strategy — replicate the model,
+shard the data (SURVEY.md §2.5: Spark partitions + CNTK's MPI ring; no
+tensor/pipeline parallelism exists). The TPU build adds tensor parallelism
+the idiomatic XLA way: params carry :class:`~jax.sharding.NamedSharding`
+annotations derived from small declarative rules, and GSPMD inserts the
+all-gathers/reduce-scatters over ICI — no hand-written collectives in the
+model code (the scaling-book recipe).
+
+A rule set is an ordered list of ``(regex, spec_tuple)``; the first regex
+matching the '/'-joined param path wins. Spec axis names not present in the
+target mesh degrade to replicated, so one rule set serves data-only meshes
+and dp×tp meshes unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.parallel.mesh import MODEL_AXIS
+
+#: Megatron-style rules for the transformer family
+#: (models/transformer.py): column-parallel into attention/MLP, row-parallel
+#: out of them — the matched pairs keep activations replicated at block
+#: boundaries with one psum per block, which XLA derives automatically.
+TRANSFORMER_TP_RULES: list[tuple[str, tuple]] = [
+    (r"qkv/kernel$", (None, MODEL_AXIS)),
+    (r"attn_out/kernel$", (MODEL_AXIS, None)),
+    (r"mlp_in/kernel$", (None, MODEL_AXIS)),
+    (r"mlp_out/kernel$", (MODEL_AXIS, None)),
+    (r"qkv/bias$", (MODEL_AXIS,)),
+    (r"mlp_in/bias$", (MODEL_AXIS,)),
+]
+
+
+def spec_for_path(path: str, rules: Sequence[tuple[str, tuple]],
+                  mesh) -> P:
+    """Resolve the PartitionSpec for one param path; unmatched or
+    mesh-incompatible rules fall back to replication per-axis."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            axes = tuple(
+                a if (a is None or (a in mesh.shape and mesh.shape[a] > 1))
+                else None
+                for a in spec
+            )
+            return P(*axes)
+    return P()
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+def build_param_shardings(params, mesh,
+                          rules: Sequence[tuple[str, tuple]] | None):
+    """Pytree of NamedSharding matching ``params``; dims that a rule would
+    shard unevenly degrade to replicated (XLA requires even tiling)."""
+    rules = rules or []
+
+    def one(key_path, leaf):
+        spec = spec_for_path(_path_str(key_path), rules, mesh)
+        axes = []
+        for i, a in enumerate(spec):
+            if a is not None and (
+                i >= leaf.ndim or leaf.shape[i] % mesh.shape[a]
+            ):
+                a = None
+            axes.append(a)
+        return NamedSharding(mesh, P(*axes))
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(params, mesh, rules=None):
+    """device_put the param tree according to the rules."""
+    return jax.device_put(params, build_param_shardings(params, mesh, rules))

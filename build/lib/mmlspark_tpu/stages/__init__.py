@@ -1,0 +1,30 @@
+"""The pipeline-stage surface: importing this package registers every stage.
+
+Mirrors the reference's per-capability sbt sub-projects (SURVEY.md §2.3-2.7);
+each module here corresponds to one or more reference modules and the import
+below is what populates :meth:`PipelineStage.registry` (the analog of
+JarLoadingUtils loading every Transformer/Estimator from built jars).
+"""
+
+_STAGE_MODULES = [
+    "dnn_model",
+    "dnn_learner",
+    "value_indexer",
+    "featurize",
+    "text",
+    "word2vec",
+    "trees",
+    "classical",
+    "train_classifier",
+    "train_regressor",
+    "eval_metrics",
+    "find_best",
+    "image",
+    "prep",
+    "ensemble",
+]
+
+import importlib
+
+for _m in _STAGE_MODULES:
+    importlib.import_module(f"mmlspark_tpu.stages.{_m}")

@@ -1,0 +1,708 @@
+"""Histogram tree learners: decision tree / random forest / gradient boosting.
+
+Reference learner dispatch: train-classifier/src/main/scala/
+TrainClassifier.scala:45-52 (DecisionTreeClassifier, GBTClassifier,
+RandomForestClassifier) and train-regressor/src/main/scala/
+TrainRegressor.scala:21-130. The reference delegates to Spark MLlib's
+row-partitioned CPU trees; there is no native kernel to mirror, so the
+TPU-first design maps tree FITTING itself onto XLA:
+
+- features are quantile-binned once (host quantiles) into small-int codes;
+  all split search then runs over the ``[n, d]`` bin matrix on device
+- per-depth-level ``(node, feature, bin)`` histograms are one
+  ``jax.ops.segment_sum`` over row-major segment ids, feature-chunked with
+  ``lax.map`` so memory stays bounded at large hashed-feature dims; the
+  per-level program compiles once per level shape and is reused across
+  every tree and boosting round
+- split gain, best-split argmax and row routing are vectorized lax ops —
+  no data-dependent Python control flow anywhere in the build loop
+- prediction is a depth-unrolled gather chain, jit-compiled
+
+Trees are flat heap-indexed arrays (split feature, threshold bin, leaf
+values), so a whole ensemble is a few dense tensors and serialization is
+plain npz. Leaf bookkeeping is implicit: a node whose best gain fails the
+threshold keeps the sentinel "route everything left" split, and since its
+left child sees identical statistics it fails the threshold again — leaf
+values simply accumulate at the bottom level.
+
+Defaults follow Spark MLlib's (maxDepth=5, maxBins=32, numTrees=20,
+stepSize=0.1, maxIter=20) so TrainClassifier/TrainRegressor behave like the
+reference out of the box.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasOutputCol,
+    Param,
+    positive,
+)
+from mmlspark_tpu.core.stage import Estimator, Model
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.data.feed import stack_column
+
+_EPS = 1e-12
+#: features are processed in chunks of this many columns per segment_sum so
+#: the [n, chunk] id tensor stays small at d = 2^12 hashed dims
+_FEATURE_CHUNK = 256
+
+
+# ---------------------------------------------------------------------------
+# binning
+
+
+def quantile_edges(x: np.ndarray, max_bins: int) -> np.ndarray:
+    """Per-column quantile bin edges, shape [d, max_bins - 1].
+
+    Duplicate quantiles (constant / few-valued columns) collapse to +inf
+    padding so they never split rows.
+    """
+    d = x.shape[1]
+    qs = np.linspace(0.0, 1.0, max_bins + 1)[1:-1]
+    edges = np.full((d, max_bins - 1), np.inf, dtype=np.float64)
+    for j in range(d):
+        col = x[:, j]
+        col = col[np.isfinite(col)]
+        if col.size == 0:
+            continue
+        e = np.unique(np.quantile(col, qs))
+        e = e[e < col.max()]  # an edge >= max separates nothing
+        edges[j, : e.size] = e
+    return edges
+
+
+def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Bin values into [0, max_bins) codes via the per-column edges."""
+    n, d = x.shape
+    out = np.empty((n, d), dtype=np.int32)
+    for j in range(d):
+        out[:, j] = np.searchsorted(edges[j], x[:, j], side="right")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jitted build steps (shapes static per depth level; cached across trees)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "max_bins"))
+def _level_histogram(bins, stats, slot, n_nodes: int, max_bins: int):
+    """[n_nodes, d, max_bins, s] sums of per-row stats.
+
+    Feature-chunked segment_sum: ids are row-major over (node, feature
+    within chunk, bin).
+    """
+    n, d = bins.shape
+    s = stats.shape[1]
+    chunk = min(d, _FEATURE_CHUNK)
+    pad = (-d) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+    n_chunks = (d + pad) // chunk
+    # [n_chunks, n, chunk]
+    chunked = jnp.moveaxis(
+        bins.reshape(n, n_chunks, chunk), 1, 0
+    )
+
+    def one_chunk(cb):
+        seg = (slot[:, None] * chunk + jnp.arange(chunk)[None, :]) * max_bins
+        seg = seg + cb  # [n, chunk]
+        data = jnp.broadcast_to(stats[:, None, :], (n, chunk, s))
+        hist = jax.ops.segment_sum(
+            data.reshape(n * chunk, s),
+            seg.reshape(n * chunk),
+            num_segments=n_nodes * chunk * max_bins,
+        )
+        return hist.reshape(n_nodes, chunk, max_bins, s)
+
+    hists = jax.lax.map(one_chunk, chunked)  # [n_chunks, nodes, chunk, B, s]
+    hists = jnp.moveaxis(hists, 0, 1).reshape(
+        n_nodes, n_chunks * chunk, max_bins, s
+    )
+    return hists[:, :d]
+
+
+@partial(jax.jit, static_argnames=("max_bins",))
+def _best_split_xgb(
+    hist, feat_mask, max_bins: int, lam, min_child, min_gain
+):
+    """Second-order (g, h, count) split search.
+
+    hist: [nodes, d, B, 3] with channels (grad, hess, count).
+    Returns per-node (feat, thresh_bin) with the sentinel thresh=B when no
+    valid split clears min_gain.
+    """
+    left = jnp.cumsum(hist, axis=2)[:, :, :-1, :]  # thresh t: bins <= t
+    total = jnp.sum(hist, axis=2, keepdims=True)
+    right = total - left
+
+    def score(g, h):
+        return (g * g) / (h + lam + _EPS)
+
+    gain = (
+        score(left[..., 0], left[..., 1])
+        + score(right[..., 0], right[..., 1])
+        - score(total[..., 0], total[..., 1])
+    )
+    valid = (
+        (left[..., 2] >= min_child)
+        & (right[..., 2] >= min_child)
+        & feat_mask[None, :, None]
+    )
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(gain.shape[0], -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    nbins = max_bins - 1
+    feat = (best // nbins).astype(jnp.int32)
+    thresh = (best % nbins).astype(jnp.int32)
+    # >= : zero-gain ties still split (sklearn semantics) — on XOR-like
+    # data every root split has exactly zero gain and refusing would freeze
+    # the tree at depth 0
+    ok = best_gain >= min_gain
+    return (
+        jnp.where(ok, feat, 0),
+        jnp.where(ok, thresh, max_bins),  # sentinel: everything goes left
+    )
+
+
+@partial(jax.jit, static_argnames=("max_bins",))
+def _best_split_gini(hist, feat_mask, max_bins: int, min_child, min_gain):
+    """Gini impurity-decrease split search over per-class count stats.
+
+    hist: [nodes, d, B, K] class counts.
+    """
+    left = jnp.cumsum(hist, axis=2)[:, :, :-1, :]
+    total = jnp.sum(hist, axis=2, keepdims=True)
+    right = total - left
+
+    def impurity(c):  # sum-formulation: N * gini = N - sum(c^2)/N
+        cnt = jnp.sum(c, axis=-1)
+        return cnt - jnp.sum(c * c, axis=-1) / jnp.maximum(cnt, _EPS)
+
+    gain = impurity(total) - impurity(left) - impurity(right)
+    lcnt, rcnt = jnp.sum(left, axis=-1), jnp.sum(right, axis=-1)
+    valid = (lcnt >= min_child) & (rcnt >= min_child) & feat_mask[None, :, None]
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(gain.shape[0], -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    nbins = max_bins - 1
+    feat = (best // nbins).astype(jnp.int32)
+    thresh = (best % nbins).astype(jnp.int32)
+    # >= : zero-gain ties still split (sklearn semantics) — on XOR-like
+    # data every root split has exactly zero gain and refusing would freeze
+    # the tree at depth 0
+    ok = best_gain >= min_gain
+    return jnp.where(ok, feat, 0), jnp.where(ok, thresh, max_bins)
+
+
+@jax.jit
+def _route(bins, node, feat, thresh):
+    """One level of heap routing: right iff bin > threshold bin."""
+    f = feat[node]
+    t = thresh[node]
+    b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+    return 2 * node + (b > t).astype(node.dtype)
+
+
+@partial(jax.jit, static_argnames=("n_leaves",))
+def _leaf_stats(stats, slot, n_leaves: int):
+    return jax.ops.segment_sum(stats, slot, num_segments=n_leaves)
+
+
+def _build_tree(
+    bins,
+    stats,
+    *,
+    criterion: str,
+    max_depth: int,
+    max_bins: int,
+    feat_mask,
+    lam: float = 1.0,
+    min_child: float = 1.0,
+    min_gain: float = 0.0,
+):
+    """One histogram tree. Returns (feat [2^L], thresh [2^L], leaf stat sums
+    [2^L, s]) as device arrays; leaf VALUES are derived by the caller
+    (criterion-specific)."""
+    n = bins.shape[0]
+    heap = 1 << max_depth
+    feat = jnp.zeros(heap, jnp.int32)
+    thresh = jnp.full(heap, max_bins, jnp.int32)
+    node = jnp.ones(n, jnp.int32)
+    for level in range(max_depth):
+        base = 1 << level
+        hist = _level_histogram(bins, stats, node - base, base, max_bins)
+        if criterion == "xgb":
+            f, t = _best_split_xgb(
+                hist, feat_mask, max_bins,
+                jnp.float32(lam), jnp.float32(min_child),
+                jnp.float32(min_gain),
+            )
+        else:
+            f, t = _best_split_gini(
+                hist, feat_mask, max_bins,
+                jnp.float32(min_child), jnp.float32(min_gain),
+            )
+        feat = jax.lax.dynamic_update_slice(feat, f, (base,))
+        thresh = jax.lax.dynamic_update_slice(thresh, t, (base,))
+        node = _route(bins, node, feat, thresh)
+    leaves = _leaf_stats(stats, node - heap, heap)
+    return feat, thresh, leaves
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _predict_leaves(bins, feats, threshs, max_depth: int):
+    """Leaf index per (row, tree): depth-unrolled gather chain.
+
+    feats/threshs: [T, 2^L]. Returns [n, T] int32 leaf indices.
+    """
+    n = bins.shape[0]
+    t_count = feats.shape[0]
+    node = jnp.ones((n, t_count), jnp.int32)
+    for _ in range(max_depth):
+        # gather per tree: feats[t, node[i, t]]
+        f = jax.vmap(lambda fe, nd: fe[nd], in_axes=(0, 1), out_axes=1)(
+            feats, node
+        )
+        th = jax.vmap(lambda te, nd: te[nd], in_axes=(0, 1), out_axes=1)(
+            threshs, node
+        )
+        b = jnp.take_along_axis(bins, f.reshape(n, -1), axis=1).reshape(
+            n, t_count
+        )
+        node = 2 * node + (b > th).astype(jnp.int32)
+    return node - (1 << max_depth)
+
+
+def _ensemble_leaf_values(values, leaf_idx):
+    """values [T, leaves, V], leaf_idx [n, T] -> [n, T, V]."""
+    return jax.vmap(lambda v, li: v[li], in_axes=(0, 1), out_axes=1)(
+        values, leaf_idx
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared estimator plumbing
+
+
+class _TreeParams:
+    max_depth = Param("maximum tree depth", 5, ptype=int, validator=positive)
+    max_bins = Param(
+        "histogram bins per feature", 32, ptype=int, validator=positive
+    )
+    min_instances_per_node = Param(
+        "minimum rows per child", 1, ptype=int, validator=positive
+    )
+    min_gain = Param("minimum split gain", 0.0, ptype=float)
+    seed = Param("rng seed", 0, ptype=int)
+
+
+def _prep_xy(stage, dataset, classification: bool):
+    """Shared learner input hygiene (also used by stages/classical.py):
+    dense float features, labels na-dropped (CNTKLearner.scala:58),
+    classification labels validated as indices in [0, k)."""
+    dataset.require(stage.features_col, stage.label_col)
+    x = stack_column(dataset, stage.features_col)
+    if x.dtype == object:
+        raise FriendlyError(
+            f"features column '{stage.features_col}' is ragged", stage.uid
+        )
+    x = np.asarray(x, np.float64)
+    y = np.asarray(dataset[stage.label_col])
+    if y.dtype == object:  # na.drop on labels (CNTKLearner.scala:58)
+        keep = np.array([v is not None for v in y])
+        x, y = x[keep], y[keep].astype(np.float64)
+    elif np.issubdtype(y.dtype, np.floating):
+        keep = ~np.isnan(y)
+        x, y = x[keep], y[keep]
+    if classification:
+        y = y.astype(np.int32)
+        if y.size and y.min() < 0:
+            # np.eye(k)[y] would silently wrap -1 onto class k-1
+            raise FriendlyError(
+                f"classification labels must be indices in [0, k); got "
+                f"min {int(y.min())} — reindex (e.g. ValueIndexer / "
+                f"TrainClassifier) first",
+                stage.uid,
+            )
+        k = int(y.max()) + 1 if y.size else 2
+        return x, y, max(k, 2)
+    return x, y.astype(np.float32), None
+
+
+def _feature_subset_mask(d, strategy, rng):
+    if strategy == "all":
+        return np.ones(d, bool)
+    if strategy == "sqrt":
+        m = max(1, int(np.sqrt(d)))
+    elif strategy == "onethird":
+        m = max(1, d // 3)
+    elif strategy == "log2":
+        m = max(1, int(np.log2(d)))
+    else:
+        raise ValueError(f"unknown feature_subset strategy {strategy!r}")
+    mask = np.zeros(d, bool)
+    mask[rng.choice(d, size=m, replace=False)] = True
+    return mask
+
+
+class _FittedTreeBase(Model, HasFeaturesCol, HasOutputCol):
+    """Shared transform path: bin with saved edges, run the gather chain."""
+
+    _abstract = True
+
+    edges = Param("per-feature quantile bin edges [d, B-1]")
+    feats = Param("split feature per heap node, [T, 2^L]")
+    threshs = Param("split threshold bin per heap node, [T, 2^L]")
+    values = Param("leaf values, [T, 2^L, V]")
+    max_depth = Param("tree depth", 5, ptype=int)
+
+    def __init__(self, **kwargs: Any):
+        kwargs.setdefault("output_col", "scores")
+        super().__init__(**kwargs)
+
+    def _leaf_values(self, dataset: Dataset):
+        x = stack_column(dataset, self.features_col)
+        x = np.asarray(x, np.float64)
+        bins = jnp.asarray(bin_features(x, np.asarray(self.edges)))
+        leaf_idx = _predict_leaves(
+            bins,
+            jnp.asarray(self.feats),
+            jnp.asarray(self.threshs),
+            int(self.max_depth),
+        )
+        return _ensemble_leaf_values(jnp.asarray(self.values), leaf_idx)
+
+
+class TreeClassifierModel(_FittedTreeBase):
+    """Averaged-probability tree/forest classifier.
+
+    ``values`` hold per-leaf class probabilities; scores are
+    log(mean probability) so the downstream softmax recovers the mean
+    probabilities exactly.
+    """
+
+    def _transform(self, dataset: Dataset) -> Dataset:
+        per_tree = self._leaf_values(dataset)  # [n, T, K]
+        probs = np.asarray(jnp.mean(per_tree, axis=1), np.float64)
+        scores = np.log(np.maximum(probs, 1e-15))
+        return dataset.with_column(self.output_col, scores)
+
+
+class GBTClassifierModel(_FittedTreeBase):
+    """Boosted softmax-margin classifier: scores = prior + lr * sum(trees).
+
+    ``values`` hold per-leaf per-class margin increments [T, leaves, K].
+    """
+
+    step_size = Param("shrinkage", 0.1, ptype=float)
+    base = Param("prior logits [K]")
+
+    def _transform(self, dataset: Dataset) -> Dataset:
+        per_tree = self._leaf_values(dataset)  # [n, T, K]
+        margins = jnp.sum(per_tree, axis=1) * self.step_size
+        scores = np.asarray(margins, np.float64) + np.asarray(self.base)
+        return dataset.with_column(self.output_col, scores)
+
+
+class TreeRegressorModel(_FittedTreeBase):
+    """Mean-over-trees regressor (decision tree = T-of-1 forest)."""
+
+    def _transform(self, dataset: Dataset) -> Dataset:
+        per_tree = self._leaf_values(dataset)  # [n, T, 1]
+        pred = np.asarray(jnp.mean(per_tree, axis=1)[:, 0], np.float64)
+        return dataset.with_column(self.output_col, pred)
+
+
+class GBTRegressorModel(_FittedTreeBase):
+    step_size = Param("shrinkage", 0.1, ptype=float)
+    base = Param("initial prediction (label mean)", 0.0, ptype=float)
+
+    def _transform(self, dataset: Dataset) -> Dataset:
+        per_tree = self._leaf_values(dataset)  # [n, T, 1]
+        pred = (
+            np.asarray(jnp.sum(per_tree, axis=1)[:, 0], np.float64)
+            * self.step_size
+            + self.base
+        )
+        return dataset.with_column(self.output_col, pred)
+
+
+# ---------------------------------------------------------------------------
+# estimators
+
+
+class DecisionTreeClassifier(
+    Estimator, _TreeParams, HasFeaturesCol, HasLabelCol
+):
+    """Gini histogram decision tree (TrainClassifier.scala:46)."""
+
+    num_trees = Param("trees in the forest", 1, ptype=int, validator=positive)
+    subsample = Param(
+        "bootstrap rows per tree (False = use all rows)", False, ptype=bool
+    )
+    feature_subset = Param(
+        "features considered per tree", "all",
+        domain=("all", "sqrt", "onethird", "log2"),
+    )
+
+    def _fit(self, dataset: Dataset) -> TreeClassifierModel:
+        x, y, k = _prep_xy(self, dataset, classification=True)
+        edges = quantile_edges(x, self.max_bins)
+        bins = jnp.asarray(bin_features(x, edges))
+        onehot = jnp.asarray(np.eye(k, dtype=np.float32)[y])
+        rng = np.random.default_rng(self.seed)
+        feats, threshs, values = [], [], []
+        for _ in range(self.num_trees):
+            w = (
+                rng.poisson(1.0, size=len(y)).astype(np.float32)
+                if self.subsample
+                else np.ones(len(y), np.float32)
+            )
+            mask = jnp.asarray(
+                _feature_subset_mask(x.shape[1], self.feature_subset, rng)
+            )
+            f, t, leaves = _build_tree(
+                bins,
+                onehot * jnp.asarray(w)[:, None],
+                criterion="gini",
+                max_depth=self.max_depth,
+                max_bins=self.max_bins,
+                feat_mask=mask,
+                min_child=float(self.min_instances_per_node),
+                min_gain=self.min_gain,
+            )
+            cnt = jnp.sum(leaves, axis=1, keepdims=True)
+            # empty leaves are unreachable (min_instances >= 1 forbids empty
+            # children; sentinel splits route all rows left) — uniform filler
+            probs = jnp.where(
+                cnt > 0, leaves / jnp.maximum(cnt, _EPS), 1.0 / k
+            )
+            feats.append(np.asarray(f))
+            threshs.append(np.asarray(t))
+            values.append(np.asarray(probs, np.float32))
+        return TreeClassifierModel(
+            edges=edges,
+            feats=np.stack(feats),
+            threshs=np.stack(threshs),
+            values=np.stack(values),
+            max_depth=self.max_depth,
+            features_col=self.features_col,
+        )
+
+
+class RandomForestClassifier(DecisionTreeClassifier):
+    """Bootstrap + feature-subsampled forest (TrainClassifier.scala:50).
+
+    Spark defaults: numTrees=20, featureSubsetStrategy auto -> sqrt.
+    """
+
+    num_trees = Param("trees in the forest", 20, ptype=int, validator=positive)
+    subsample = Param("bootstrap rows per tree", True, ptype=bool)
+    feature_subset = Param(
+        "features considered per tree", "sqrt",
+        domain=("all", "sqrt", "onethird", "log2"),
+    )
+
+
+class DecisionTreeRegressor(
+    Estimator, _TreeParams, HasFeaturesCol, HasLabelCol
+):
+    """Variance-reduction histogram regression tree (TrainRegressor)."""
+
+    num_trees = Param("trees in the forest", 1, ptype=int, validator=positive)
+    subsample = Param(
+        "bootstrap rows per tree (False = use all rows)", False, ptype=bool
+    )
+    feature_subset = Param(
+        "features considered per tree", "all",
+        domain=("all", "sqrt", "onethird", "log2"),
+    )
+    lambda_ = Param("L2 regularization on leaf values", 0.0, ptype=float)
+
+    def _fit(self, dataset: Dataset) -> TreeRegressorModel:
+        x, y, _ = _prep_xy(self, dataset, classification=False)
+        edges = quantile_edges(x, self.max_bins)
+        bins = jnp.asarray(bin_features(x, edges))
+        rng = np.random.default_rng(self.seed)
+        feats, threshs, values = [], [], []
+        for _ in range(self.num_trees):
+            w = (
+                rng.poisson(1.0, size=len(y)).astype(np.float32)
+                if self.subsample
+                else np.ones(len(y), np.float32)
+            )
+            mask = jnp.asarray(
+                _feature_subset_mask(x.shape[1], self.feature_subset, rng)
+            )
+            # variance-reduction == second-order gain with g=-y, h=1
+            # (leaf value -G/(H+lam) is then the within-leaf label mean)
+            stats = jnp.stack(
+                [jnp.asarray(-y * w), jnp.asarray(w), jnp.asarray(w)], axis=1
+            )
+            f, t, leaves = _build_tree(
+                bins,
+                stats,
+                criterion="xgb",
+                max_depth=self.max_depth,
+                max_bins=self.max_bins,
+                feat_mask=mask,
+                lam=self.lambda_,
+                min_child=float(self.min_instances_per_node),
+                min_gain=self.min_gain,
+            )
+            val = -leaves[:, 0:1] / (leaves[:, 1:2] + self.lambda_ + _EPS)
+            feats.append(np.asarray(f))
+            threshs.append(np.asarray(t))
+            values.append(np.asarray(val, np.float32))
+        return TreeRegressorModel(
+            edges=edges,
+            feats=np.stack(feats),
+            threshs=np.stack(threshs),
+            values=np.stack(values),
+            max_depth=self.max_depth,
+            features_col=self.features_col,
+        )
+
+
+class RandomForestRegressor(DecisionTreeRegressor):
+    """Spark defaults: numTrees=20, featureSubsetStrategy auto -> onethird."""
+
+    num_trees = Param("trees in the forest", 20, ptype=int, validator=positive)
+    subsample = Param("bootstrap rows per tree", True, ptype=bool)
+    feature_subset = Param(
+        "features considered per tree", "onethird",
+        domain=("all", "sqrt", "onethird", "log2"),
+    )
+
+
+class GBTClassifier(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
+    """Softmax gradient boosting (TrainClassifier.scala:47).
+
+    Spark's GBTClassifier is binary-only; this one boosts K softmax margins
+    directly, so multiclass needs no OneVsRest wrap — an intentional
+    capability superset.
+    """
+
+    max_iter = Param("boosting rounds", 20, ptype=int, validator=positive)
+    step_size = Param("shrinkage", 0.1, ptype=float)
+    lambda_ = Param("L2 regularization on leaf values", 1.0, ptype=float)
+
+    def _fit(self, dataset: Dataset) -> GBTClassifierModel:
+        x, y, k = _prep_xy(self, dataset, classification=True)
+        edges = quantile_edges(x, self.max_bins)
+        bins = jnp.asarray(bin_features(x, edges))
+        onehot = jnp.asarray(np.eye(k, dtype=np.float32)[y])
+        prior = np.log(
+            np.maximum(np.bincount(y, minlength=k) / max(len(y), 1), 1e-15)
+        )
+        margins = jnp.broadcast_to(
+            jnp.asarray(prior, jnp.float32)[None, :], (len(y), k)
+        )
+        mask = jnp.ones(x.shape[1], bool)
+        feats, threshs, values = [], [], []
+        ones = jnp.ones(len(y), jnp.float32)
+        for _ in range(self.max_iter):
+            p = jax.nn.softmax(margins, axis=1)
+            g = p - onehot  # d/dF of softmax cross-entropy
+            h = p * (1.0 - p)
+            round_vals = []
+            f = t = None
+            for c in range(k):
+                stats = jnp.stack([g[:, c], h[:, c], ones], axis=1)
+                f, t, leaves = _build_tree(
+                    bins,
+                    stats,
+                    criterion="xgb",
+                    max_depth=self.max_depth,
+                    max_bins=self.max_bins,
+                    feat_mask=mask,
+                    lam=self.lambda_,
+                    min_child=float(self.min_instances_per_node),
+                    min_gain=self.min_gain,
+                )
+                val = -leaves[:, 0] / (leaves[:, 1] + self.lambda_ + _EPS)
+                leaf_idx = _predict_leaves(
+                    bins, f[None], t[None], self.max_depth
+                )[:, 0]
+                margins = margins.at[:, c].add(self.step_size * val[leaf_idx])
+                feats.append(np.asarray(f))
+                threshs.append(np.asarray(t))
+                # one tree per class per round: leaf value vector is the
+                # class-c one-hot of the margin increment
+                v = np.zeros((val.shape[0], k), np.float32)
+                v[:, c] = np.asarray(val)
+                round_vals.append(v)
+            values.extend(round_vals)
+        return GBTClassifierModel(
+            edges=edges,
+            feats=np.stack(feats),
+            threshs=np.stack(threshs),
+            values=np.stack(values),
+            max_depth=self.max_depth,
+            step_size=self.step_size,
+            base=prior,
+            features_col=self.features_col,
+        )
+
+
+class GBTRegressor(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
+    """Squared-loss gradient boosting (TrainRegressor.scala learner list)."""
+
+    max_iter = Param("boosting rounds", 20, ptype=int, validator=positive)
+    step_size = Param("shrinkage", 0.1, ptype=float)
+    lambda_ = Param("L2 regularization on leaf values", 1.0, ptype=float)
+
+    def _fit(self, dataset: Dataset) -> GBTRegressorModel:
+        x, y, _ = _prep_xy(self, dataset, classification=False)
+        edges = quantile_edges(x, self.max_bins)
+        bins = jnp.asarray(bin_features(x, edges))
+        base = float(np.mean(y)) if len(y) else 0.0
+        pred = jnp.full(len(y), base, jnp.float32)
+        yj = jnp.asarray(y)
+        ones = jnp.ones(len(y), jnp.float32)
+        mask = jnp.ones(x.shape[1], bool)
+        feats, threshs, values = [], [], []
+        for _ in range(self.max_iter):
+            g = pred - yj  # d/dF of 0.5*(F - y)^2
+            stats = jnp.stack([g, ones, ones], axis=1)
+            f, t, leaves = _build_tree(
+                bins,
+                stats,
+                criterion="xgb",
+                max_depth=self.max_depth,
+                max_bins=self.max_bins,
+                feat_mask=mask,
+                lam=self.lambda_,
+                min_child=float(self.min_instances_per_node),
+                min_gain=self.min_gain,
+            )
+            val = -leaves[:, 0] / (leaves[:, 1] + self.lambda_ + _EPS)
+            leaf_idx = _predict_leaves(bins, f[None], t[None], self.max_depth)[
+                :, 0
+            ]
+            pred = pred + self.step_size * val[leaf_idx]
+            feats.append(np.asarray(f))
+            threshs.append(np.asarray(t))
+            values.append(np.asarray(val[:, None], np.float32))
+        return GBTRegressorModel(
+            edges=edges,
+            feats=np.stack(feats),
+            threshs=np.stack(threshs),
+            values=np.stack(values),
+            max_depth=self.max_depth,
+            step_size=self.step_size,
+            base=base,
+            features_col=self.features_col,
+        )

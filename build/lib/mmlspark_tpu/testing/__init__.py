@@ -1,0 +1,2 @@
+"""Test support library (shipped, like the reference's core/test/{base,
+datagen,fuzzing} sbt projects — SURVEY.md §2/L9)."""

@@ -1,0 +1,116 @@
+"""Random dataset generation for verification.
+
+Reference: core/test/datagen/src/main/scala (``GenerateDataset`` builds random
+DataFrames from ``DatasetOptions`` — types x missings x dimensions — with
+seeds; used by VerifyTrainClassifier for benchmark-style verification).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from mmlspark_tpu.data.dataset import Dataset
+
+
+@dataclass(frozen=True)
+class DatasetOptions:
+    """What shapes/types to generate (GenerateDataset's options object)."""
+
+    num_rows: int = 32
+    num_numeric: int = 2
+    num_string: int = 1
+    num_bool: int = 1
+    num_vector: int = 0
+    vector_dim: int = 4
+    missing_ratio: float = 0.0  # NaN fraction in numeric columns
+    string_vocab: tuple = ("alpha", "beta", "gamma", "delta")
+    with_label: bool = True
+    label_kind: str = "binary"  # binary | multiclass | continuous
+    num_classes: int = 3
+    extra: dict = field(default_factory=dict)
+
+
+def generate_dataset(
+    options: DatasetOptions = DatasetOptions(), seed: int = 0
+) -> Dataset:
+    rng = np.random.default_rng(seed)
+    n = options.num_rows
+    cols: dict = {}
+    for i in range(options.num_numeric):
+        vals = rng.normal(size=n)
+        if options.missing_ratio > 0:
+            mask = rng.random(n) < options.missing_ratio
+            vals = np.where(mask, np.nan, vals)
+        cols[f"num_{i}"] = vals
+    for i in range(options.num_string):
+        cols[f"str_{i}"] = list(rng.choice(options.string_vocab, n))
+    for i in range(options.num_bool):
+        cols[f"bool_{i}"] = rng.random(n) > 0.5
+    for i in range(options.num_vector):
+        cols[f"vec_{i}"] = rng.normal(size=(n, options.vector_dim))
+    if options.with_label:
+        if options.label_kind == "binary":
+            cols["label"] = list(
+                np.where(rng.random(n) > 0.5, "yes", "no")
+            )
+        elif options.label_kind == "multiclass":
+            cols["label"] = rng.integers(0, options.num_classes, n).astype(
+                np.int64
+            )
+        else:
+            cols["label"] = rng.normal(size=n)
+    return Dataset(cols)
+
+
+def make_census(n: int = 600, seed: int = 7, full_schema: bool = False) -> Dataset:
+    """Adult-Census-shaped synthetic table (notebook 101's input shape).
+
+    One generator shared by the e101 example, bench.py's TrainClassifier
+    epoch metric and tests, so the schema/label rule cannot drift between
+    them. ``full_schema`` adds the remaining census columns (14 features,
+    the real Adult schema width); the compact form keeps the 4 used by the
+    example.
+    """
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(18, 80, n)
+    hours = rng.uniform(10, 60, n)
+    edu = rng.choice(
+        ["hs", "college", "bachelors", "masters", "phd"]
+        if full_schema
+        else ["hs", "college", "phd"],
+        n,
+    )
+    occupation = rng.choice(["clerical", "exec", "tech", "service"], n)
+    score = (age - 40) / 20 + (hours - 35) / 15 + (edu == "phd") * 1.5
+    cols = {
+        "age": age,
+        "hours_per_week": hours,
+        "education": list(edu),
+        "occupation": list(occupation),
+    }
+    if full_schema:
+        edu_num = rng.integers(1, 16, n).astype(np.float64)
+        score = score + (edu_num - 8) / 6
+        cols.update({
+            "fnlwgt": rng.uniform(1e4, 1e6, n),
+            "education_num": edu_num,
+            "capital_gain": rng.exponential(500.0, n),
+            "capital_loss": rng.exponential(80.0, n),
+            "marital_status": list(
+                rng.choice(["married", "single", "divorced"], n)
+            ),
+            "relationship": list(
+                rng.choice(["husband", "wife", "own-child", "unmarried"], n)
+            ),
+            "race": list(rng.choice(["a", "b", "c", "d"], n)),
+            "sex": list(rng.choice(["m", "f"], n)),
+            "native_country": list(
+                rng.choice(["us", "mx", "ph", "de", "other"], n)
+            ),
+            "workclass": list(rng.choice(["private", "gov", "self"], n)),
+        })
+    label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
+    cols["income"] = list(label)
+    return Dataset(cols)

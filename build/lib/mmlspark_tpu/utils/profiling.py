@@ -1,0 +1,46 @@
+"""Profiling hooks: jax.profiler traces around pipeline work.
+
+The reference's only tracing is the Timer stage's wall-clock logging
+(pipeline-stages/src/main/scala/Timer.scala:14-123) — no sampling profiler
+exists (SURVEY.md §5). The TPU build keeps Timer and adds the natural
+upgrade the survey calls for: XLA-level traces via ``jax.profiler``,
+viewable in TensorBoard/Perfetto, capturing compilation, device compute,
+and host↔device transfers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger("profiling")
+
+
+@contextlib.contextmanager
+def trace_profile(log_dir: str, create_perfetto_link: bool = False):
+    """Context manager writing a jax.profiler trace under ``log_dir``.
+
+    Usage::
+
+        with trace_profile("/tmp/trace"):
+            model.transform(ds)   # device work captured
+    """
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(
+        log_dir, create_perfetto_link=create_perfetto_link
+    ):
+        yield log_dir
+    _log.info("profiler trace written under %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the device trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -23,20 +23,9 @@ from mmlspark_tpu.stages.image import ImageFeaturizer
 from mmlspark_tpu.stages.prep import SelectColumns
 from mmlspark_tpu.stages.train_classifier import TrainClassifier
 
+from mmlspark_tpu.testing.datagen import blob_images
+
 ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "zoo_repo")
-
-
-def blob_images(n, seed, classes=2):
-    """Two visual classes: bright-top vs bright-bottom uint8 images."""
-    rng = np.random.default_rng(seed)
-    y = rng.integers(0, classes, n)
-    imgs = []
-    for label in y:
-        img = rng.integers(0, 80, (32, 32, 3))
-        half = slice(0, 16) if label == 0 else slice(16, 32)
-        img[half] += 150
-        imgs.append(np.clip(img, 0, 255).astype(np.uint8))
-    return imgs, y
 
 
 def main():

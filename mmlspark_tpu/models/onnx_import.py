@@ -327,6 +327,16 @@ def _rnn_parts(node, env, a, n_gates: int):
             f"ONNX {node.op} '{node.name}': only default activations "
             f"{_DEFAULT_ACTS[node.op]} are supported, got {acts}"
         )
+    if "clip" in a and a["clip"].f:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': cell clipping (clip="
+            f"{a['clip'].f}) is not supported"
+        )
+    if "layout" in a and a["layout"].i:
+        raise FriendlyError(
+            f"ONNX {node.op} '{node.name}': layout=1 (batch-major) is "
+            "not supported; export with the default seq-major layout"
+        )
     b = _opt_input(node, env, 3)
     if b is None:
         wb = jnp.zeros((dirs, n_gates * hidden), x.dtype)

@@ -114,3 +114,23 @@ def make_census(n: int = 600, seed: int = 7, full_schema: bool = False) -> Datas
     label = np.where(score + rng.normal(0, 0.4, n) > 0, ">50K", "<=50K")
     cols["income"] = list(label)
     return Dataset(cols)
+
+
+def blob_images(n: int, seed: int, classes: int = 2):
+    """Two visual classes — bright-top vs bright-bottom 32x32 uint8 images.
+
+    The single source for the e303 transfer-learning example, the
+    committed zoo payload's training set (tools/publish_zoo.py) and the
+    image fixtures (tools/make_fixtures.py): one definition keeps the
+    pretrained payload and every consumer on the same distribution.
+    Returns (list of HWC uint8 arrays, labels).
+    """
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    imgs = []
+    for label in y:
+        img = rng.integers(0, 80, (32, 32, 3))
+        half = slice(0, 16) if label == 0 else slice(16, 32)
+        img[half] += 150
+        imgs.append(np.clip(img, 0, 255).astype(np.uint8))
+    return imgs, y

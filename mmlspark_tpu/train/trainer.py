@@ -428,9 +428,13 @@ class SPMDTrainer:
                     # gate BEFORE building args: _ckpt_args device_gets the
                     # whole (possibly TP-sharded) state, which would stall
                     # async dispatch on every non-checkpoint step
+                    # force: the any() guard above IS the cadence decision;
+                    # orbax would otherwise re-gate on the group-end step,
+                    # which is generally off-cadence under chunked dispatch
                     mngr.save(
                         step - 1,
                         args=_ckpt_args(params, rest, opt_state),
+                        force=True,
                     )
             if eval_fn is not None:
                 variables = _merge_variables(

@@ -15,35 +15,24 @@ import os
 import sys
 import zipfile
 
-import numpy as np
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures")
-
-
-def blob_image(rng, label: int) -> np.ndarray:
-    img = rng.integers(0, 80, (32, 32, 3))
-    half = slice(0, 16) if label == 0 else slice(16, 32)
-    img[half] += 150
-    return np.clip(img, 0, 255).astype(np.uint8)
 
 
 def main() -> None:
     from PIL import Image
 
     sys.path.insert(0, REPO)
-    from mmlspark_tpu.testing.datagen import make_census
+    from mmlspark_tpu.testing.datagen import blob_images, make_census
 
     img_dir = os.path.join(FIXTURES, "images")
     os.makedirs(img_dir, exist_ok=True)
-    rng = np.random.default_rng(42)
+    imgs, labels = blob_images(30, seed=42)
     # class in the filename (like the notebook datasets' dir layout)
     for i in range(24):
-        label = i % 2
-        arr = blob_image(rng, label)
-        name = f"{['top', 'bottom'][label]}_{i:02d}"
+        name = f"{['top', 'bottom'][labels[i]]}_{i:02d}"
         ext = "png" if i % 3 else "jpg"  # a third jpeg, rest png
-        Image.fromarray(arr).save(
+        Image.fromarray(imgs[i]).save(
             os.path.join(img_dir, f"{name}.{ext}"), quality=95
         )
     # a zip archive for the transparent zip-traversal path
@@ -51,11 +40,11 @@ def main() -> None:
     zpath = os.path.join(FIXTURES, "images_extra.zip")
     with zipfile.ZipFile(zpath, "w") as z:
         for i in range(24, 30):
-            label = i % 2
-            arr = blob_image(rng, label)
             tmp = os.path.join(img_dir, "_tmp.png")
-            Image.fromarray(arr).save(tmp)
-            z.write(tmp, f"zipped/{['top', 'bottom'][label]}_{i:02d}.png")
+            Image.fromarray(imgs[i]).save(tmp)
+            z.write(
+                tmp, f"zipped/{['top', 'bottom'][labels[i]]}_{i:02d}.png"
+            )
             os.remove(tmp)
 
     census = make_census(400, seed=11)

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher (the reference's cluster-install +
+# MultiNodeParallelLauncher role: tools/hdi/install-mmlspark.sh:1-40 and
+# cntk-train/.../CommandBuilders.scala:95-116 — an MPI hostfile driving
+# mpiexec). The TPU-native equivalent: run the SAME program on every host
+# of the slice; jax.distributed + GSPMD handle the rest (see
+# mmlspark_tpu/parallel/mesh.py initialize_distributed and the executed
+# two-process test in tests/test_multihost.py).
+#
+# Usage (from any machine with SSH to the pod workers):
+#   tools/pod/launch-pod.sh <hostfile> <script.py> [args...]
+# where <hostfile> lists one worker address per line (host 0 = coordinator,
+# the hostfile replacing the MPI 'host slots=N' file one-for-one).
+#
+# On TPU pod slices created through a cloud provider, the provider's
+# "run on all workers" command (e.g. gcloud ... tpu-vm ssh --worker=all)
+# can replace the ssh loop; the env contract below stays the same.
+set -euo pipefail
+
+HOSTFILE="${1:?usage: launch-pod.sh <hostfile> <script.py> [args...]}"
+SCRIPT="${2:?usage: launch-pod.sh <hostfile> <script.py> [args...]}"
+shift 2
+
+mapfile -t HOSTS < <(grep -v '^\s*$' "$HOSTFILE")
+NUM="${#HOSTS[@]}"
+COORD="${HOSTS[0]}:8476"
+
+# Every worker runs the same program with its rank; user code calls
+# mmlspark_tpu.parallel.mesh.initialize_distributed() with these (or
+# relies on the TPU runtime's automatic discovery and passes nothing).
+PIDS=()
+for i in "${!HOSTS[@]}"; do
+  ssh "${HOSTS[$i]}" \
+    "MMLSPARK_TPU_COORDINATOR=$COORD" \
+    "MMLSPARK_TPU_NUM_PROCESSES=$NUM" \
+    "MMLSPARK_TPU_PROCESS_ID=$i" \
+    python "$SCRIPT" "$@" &
+  PIDS+=("$!")
+done
+
+rc=0
+for pid in "${PIDS[@]}"; do
+  wait "$pid" || rc=$?  # non-zero exit on any worker fails the launch
+done
+exit "$rc"

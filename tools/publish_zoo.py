@@ -23,22 +23,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ZOO = os.path.join(REPO, "models", "zoo_repo")
 
 
-def blob_images(n, seed, classes=2):
-    """Same generator as examples/e303: bright-top vs bright-bottom."""
-    rng = np.random.default_rng(seed)
-    y = rng.integers(0, classes, n)
-    imgs = []
-    for label in y:
-        img = rng.integers(0, 80, (32, 32, 3))
-        half = slice(0, 16) if label == 0 else slice(16, 32)
-        img[half] += 150
-        imgs.append(np.clip(img, 0, 255).astype(np.uint8))
-    return imgs, y
-
-
 def main() -> None:
     sys.path.insert(0, REPO)
     from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.testing.datagen import blob_images
     from mmlspark_tpu.models.zoo import publish_model
     from mmlspark_tpu.stages.dnn_model import TPUModel
     from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
