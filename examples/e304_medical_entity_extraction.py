@@ -1,16 +1,27 @@
 """Notebook-304 parity: per-token entity tagging with a BiLSTM.
 
 Reference flow (notebooks/samples/304 - Medical Entity Extraction.ipynb):
-download an opaque CNTK BiLSTM graph, pad sentences to max length in
-notebook UDFs, run CNTKModel per token, map tag ids back to labels. Here
-the BiLSTM is a first-class model (models/bilstm.py) trained in-process
-on a synthetic entity task; padding uses a fixed max length exactly like
-the notebook.
+download an opaque serialized BiLSTM graph, pad sentences to max length
+in notebook UDFs, run CNTKModel per token, map tag ids back to labels.
+Here the BiLSTM is a first-class model (models/bilstm.py) trained
+in-process on a synthetic entity task, then the notebook's
+OPAQUE-SERIALIZED-GRAPH leg is reproduced for real: the trained tagger is
+exported to ONNX bytes, re-imported as an opaque graph
+(models/onnx_export.py -> load_onnx), and served through the TPUModel
+inference stage — the CNTKModel-over-downloaded-graph flow, TPU-native.
+Padding uses a fixed max length exactly like the notebook.
 """
+
+import os
+import tempfile
 
 import numpy as np
 
+from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.models import build_model
+from mmlspark_tpu.models.onnx_export import save_onnx
+from mmlspark_tpu.models.onnx_import import load_onnx
+from mmlspark_tpu.stages.dnn_model import TPUModel
 from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
 
 # tiny "medical" vocabulary: ids 0=PAD, 1..9 filler, 10..14 drug names,
@@ -47,8 +58,21 @@ def main():
     variables = trainer.train(ids, tags)
 
     test_ids, test_tags = make_sentences(128, seed=1)
-    logits = graph.apply(variables, test_ids)
-    pred = np.asarray(logits).argmax(-1)
+
+    # the notebook's opaque-graph leg: serialize -> reload as ONNX ->
+    # run through the batched inference stage
+    batch = 32
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "tagger.onnx")
+        save_onnx(graph, variables, (batch, MAX_LEN), path)
+        with open(path, "rb") as f:
+            opaque = load_onnx(f.read())
+    model = TPUModel.from_graph(
+        opaque, opaque.init(), "onnx", input_col="tokens",
+        batch_size=batch, data_parallel=False,
+    )
+    scored = model.transform(Dataset({"tokens": test_ids}))
+    pred = np.asarray(scored["scores"].tolist()).argmax(-1)
     acc = float((pred == test_tags).mean())
     entity_mask = test_tags > 0
     entity_recall = float(

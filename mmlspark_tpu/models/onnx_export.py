@@ -1,0 +1,272 @@
+"""ONNX export: trained NamedGraph families -> serialized .onnx bytes.
+
+The SAVE side of the reference's serialized-graph story: CNTK models leave
+MMLSpark as native ``.model`` files via SerializableFunction's write path
+(cntk-model/src/main/scala/SerializableFunction.scala:62-81) and re-enter
+any CNTK runtime. Here trained models leave as ONNX — the interchange
+format the importer (:mod:`mmlspark_tpu.models.onnx_import`) and every
+mainstream runtime reads — so zoo payloads can be served in a portable
+form and round-tripped (export -> ``load_onnx`` -> identical logits, see
+tests/test_onnx_export.py). Files carry the fields external checkers
+require (ir_version, opset_import @ 13, typed attributes, typed
+value_info); this zero-egress image has no onnx runtime to cross-check
+against, so external-runtime validation is structural.
+
+The writer emits the protobuf wire format directly (the encode mirror of
+the importer's decoder; no onnx package in this environment). Exported
+graphs are shape-specialized to the sample shape — consistent with the
+framework's static-shape philosophy (reshape targets bake the dims).
+
+Supported families: ``linear`` / ``mlp`` (Gemm + Relu chains) and
+``bilstm_tagger`` (Gather -> bidirectional LSTM -> per-token projection).
+Convolutional families persist via the native stage format
+(core/serialize); their ONNX export is intentionally out of scope.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format encoding
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num: int, wt: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | wt) + payload
+
+
+def _msg(num: int, body: bytes) -> bytes:
+    return _field(num, 2, _varint(len(body)) + body)
+
+
+def _s(num: int, s: str) -> bytes:
+    b = s.encode()
+    return _field(num, 2, _varint(len(b)) + b)
+
+
+def _i(num: int, v: int) -> bytes:
+    return _field(num, 0, _varint(v & (1 << 64) - 1))
+
+
+def _f(num: int, v: float) -> bytes:
+    return _field(num, 5, struct.pack("<f", v))
+
+
+_TENSOR_DTYPES = {
+    np.dtype("float32"): 1,
+    np.dtype("int32"): 6,
+    np.dtype("int64"): 7,
+}
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _TENSOR_DTYPES:
+        raise FriendlyError(f"cannot export tensor dtype {arr.dtype}")
+    body = b"".join(_i(1, d) for d in arr.shape)
+    body += _i(2, _TENSOR_DTYPES[arr.dtype]) + _s(8, name)
+    body += _field(9, 2, _varint(arr.nbytes) + arr.tobytes())
+    return body
+
+
+# AttributeProto.type values (required by onnx.checker; our importer
+# infers from the populated field but external runtimes validate it)
+_ATTR_INT, _ATTR_STRING, _ATTR_INTS = 2, 3, 7
+
+
+def attr_i(name: str, v: int) -> bytes:
+    return _s(1, name) + _i(3, v) + _i(20, _ATTR_INT)
+
+
+def attr_s(name: str, v: str) -> bytes:
+    return _s(1, name) + _s(4, v) + _i(20, _ATTR_STRING)
+
+
+def attr_ints(name: str, vs) -> bytes:
+    return _s(1, name) + b"".join(_i(8, v) for v in vs) + _i(20, _ATTR_INTS)
+
+
+def node(op: str, inputs, outputs, name: str = "", attrs=()) -> bytes:
+    body = b"".join(_s(1, i) for i in inputs)
+    body += b"".join(_s(2, o) for o in outputs)
+    body += _s(3, name) + _s(4, op)
+    body += b"".join(_msg(5, a) for a in attrs)
+    return body
+
+
+def value_info(name: str, shape, elem_type: int = 1) -> bytes:
+    """elem_type: ONNX TensorProto dtype (1=float32, 6=int32, 7=int64)."""
+    dims = b"".join(_msg(1, _i(1, d)) for d in shape)
+    tensor_type = _i(1, elem_type) + _msg(2, dims)
+    return _s(1, name) + _msg(2, _msg(1, tensor_type))
+
+
+#: every op this exporter emits exists with these semantics at opset 13
+_OPSET_VERSION = 13
+
+
+def model_proto(nodes, initializers, inputs, outputs,
+                gname: str = "mmlspark_tpu") -> bytes:
+    g = b"".join(_msg(1, n) for n in nodes)
+    g += _s(2, gname)
+    g += b"".join(_msg(5, t) for t in initializers)
+    g += b"".join(_msg(11, v) for v in inputs)
+    g += b"".join(_msg(12, v) for v in outputs)
+    opset = _msg(8, _s(1, "") + _i(2, _OPSET_VERSION))
+    return (
+        _i(1, 8)  # ir_version
+        + _s(2, "mmlspark_tpu")  # producer_name
+        + _msg(7, g)
+        + opset
+    )
+
+
+# ---------------------------------------------------------------------------
+# family exporters
+
+
+def _np(tree, *path):
+    cur = tree
+    for p in path:
+        cur = cur[p]
+    return np.asarray(cur, np.float32)
+
+
+def _export_dense_chain(variables, sample_shape, layer_names):
+    """linear / mlp: per-block Dense (+ Relu on hidden blocks)."""
+    nodes, inits = [], []
+    prev = "x"
+    for i, block in enumerate(layer_names):
+        k = _np(variables[block], "params", "Dense_0", "kernel")
+        b = _np(variables[block], "params", "Dense_0", "bias")
+        inits += [tensor_proto(f"{block}_w", k), tensor_proto(f"{block}_b", b)]
+        out = block if i == len(layer_names) - 1 else f"{block}_pre"
+        nodes.append(
+            node("Gemm", [prev, f"{block}_w", f"{block}_b"], [out],
+                 name=block)
+        )
+        if i < len(layer_names) - 1:
+            nodes.append(node("Relu", [out], [f"{block}_act"],
+                              name=f"{block}_relu"))
+            prev = f"{block}_act"
+        out_dim = k.shape[1]
+    return model_proto(
+        nodes, inits,
+        [value_info("x", sample_shape)],
+        [value_info(layer_names[-1], (sample_shape[0], out_dim))],
+    )
+
+
+#: flax LSTMCell gate letters in ONNX's i, o, f, c stacking order
+_GATES_ONNX_ORDER = ("i", "o", "f", "g")
+
+
+def _lstm_dir_weights(cell):
+    """One flax OptimizedLSTMCell param dict -> ONNX (W [4H, E],
+    R [4H, H], B [8H]) in i, o, f, c gate order."""
+    w = np.concatenate(
+        [_np(cell, f"i{g}", "kernel").T for g in _GATES_ONNX_ORDER]
+    )
+    r = np.concatenate(
+        [_np(cell, f"h{g}", "kernel").T for g in _GATES_ONNX_ORDER]
+    )
+    rb = np.concatenate(
+        [_np(cell, f"h{g}", "bias") for g in _GATES_ONNX_ORDER]
+    )
+    b = np.concatenate([np.zeros_like(rb), rb])  # flax has no input bias
+    return w, r, b
+
+
+def _export_bilstm_tagger(variables, sample_shape):
+    """embed -> bidirectional LSTM -> per-token projection; batch-major
+    (B, T) ids in, (B, T, num_tags) logits out."""
+    batch, seq = sample_shape
+    emb = _np(variables["embed"], "params", "Embed_0", "embedding")
+    fwd = variables["bilstm"]["params"]["OptimizedLSTMCell_0"]
+    bwd = variables["bilstm"]["params"]["OptimizedLSTMCell_1"]
+    wf, rf, bf = _lstm_dir_weights(fwd)
+    wb_, rb_, bb = _lstm_dir_weights(bwd)
+    w = np.stack([wf, wb_])
+    r = np.stack([rf, rb_])
+    b = np.stack([bf, bb])
+    hidden = r.shape[-1]
+    proj_k = _np(variables["z"], "params", "Dense_0", "kernel")
+    proj_b = _np(variables["z"], "params", "Dense_0", "bias")
+    num_tags = proj_k.shape[1]
+
+    nodes = [
+        # (B, T) ids -> (B, T, E) -> seq-major (T, B, E)
+        node("Gather", ["embedding", "x"], ["embedded"], name="embed",
+             attrs=[attr_i("axis", 0)]),
+        node("Transpose", ["embedded"], ["seq_major"], name="to_seq",
+             attrs=[attr_ints("perm", [1, 0, 2])]),
+        node("LSTM", ["seq_major", "W", "R", "B"], ["y", "yh", "yc"],
+             name="bilstm",
+             attrs=[attr_i("hidden_size", hidden),
+                    attr_s("direction", "bidirectional")]),
+        # Y (T, 2, B, H) -> (B, T, 2, H) -> (B, T, 2H): forward/backward
+        # halves concatenated like flax nn.Bidirectional
+        node("Transpose", ["y"], ["y_bm"], name="to_batch",
+             attrs=[attr_ints("perm", [2, 0, 1, 3])]),
+        node("Reshape", ["y_bm", "merge_shape"], ["states"], name="merge"),
+        node("MatMul", ["states", "proj_w"], ["proj"], name="proj"),
+        node("Add", ["proj", "proj_b"], ["z"], name="z"),
+    ]
+    inits = [
+        tensor_proto("embedding", emb),
+        tensor_proto("W", w),
+        tensor_proto("R", r),
+        tensor_proto("B", b),
+        tensor_proto(
+            "merge_shape",
+            np.array([batch, seq, 2 * hidden], np.int64),
+        ),
+        tensor_proto("proj_w", proj_k),
+        tensor_proto("proj_b", proj_b),
+    ]
+    return model_proto(
+        nodes, inits,
+        [value_info("x", (batch, seq), elem_type=6)],  # int32 ids
+        [value_info("z", (batch, seq, num_tags))],
+    )
+
+
+def export_onnx(graph, variables, sample_shape) -> bytes:
+    """Serialize a trained NamedGraph to ONNX bytes.
+
+    ``sample_shape`` is the full batched input shape the export is
+    specialized to (e.g. ``(batch, features)`` for mlp, ``(batch, seq)``
+    for the tagger).
+    """
+    name = graph.name
+    if name in ("linear", "mlp"):
+        return _export_dense_chain(
+            variables, tuple(sample_shape), graph.layer_names
+        )
+    if name == "bilstm_tagger":
+        return _export_bilstm_tagger(variables, tuple(sample_shape))
+    raise FriendlyError(
+        f"no ONNX exporter for model family '{name}'; supported: linear, "
+        "mlp, bilstm_tagger (conv families persist via the stage format)"
+    )
+
+
+def save_onnx(graph, variables, sample_shape, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(export_onnx(graph, variables, sample_shape))

@@ -507,7 +507,10 @@ class OnnxGraph:
         env: dict[str, Any] = {
             k: jnp.asarray(v) for k, v in params.items()
         }
-        consts: dict[str, np.ndarray] = dict(params)
+        # static-shape constants (Reshape/Slice/Squeeze operands) resolve
+        # from the graph's OWN initializers, never the caller's variables:
+        # under jit those are tracers, and shapes must stay compile-time
+        consts: dict[str, np.ndarray] = dict(self.initializers)
         env[self.input_name] = x
         out = None
         for node in self.nodes:

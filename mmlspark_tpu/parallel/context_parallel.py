@@ -77,11 +77,24 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool, scale):
 
 def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool, scale):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern): trade
-    the sequence sharding for head sharding, attend densely, trade back."""
+    the sequence sharding for head sharding, attend locally, trade back.
+
+    The local attention over the FULL sequence uses the Pallas flash
+    kernel on TPU (O(S·d) memory — after the all-to-all each device sees
+    the whole sequence, so dense would re-materialize (S, S) scores and
+    defeat the point of sharding long contexts); off-TPU the XLA dense
+    path keeps the CPU test mesh fast. Both are exact, verified against
+    each other in tests/test_parallel_attention.py.
+    """
     a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # (B, S/n, H, D) -> (B, S, H/n, D): split heads, concat sequence
     q, k, v = (a2a(t, split_axis=2, concat_axis=1) for t in (q, k, v))
-    o = dense_attention(q, k, v, causal=causal, scale=scale)
+    if jax.default_backend() == "tpu":
+        from mmlspark_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(q, k, v, causal=causal, scale=scale)
+    else:
+        o = dense_attention(q, k, v, causal=causal, scale=scale)
     # back to sequence-sharded layout
     return a2a(o, split_axis=1, concat_axis=2)
 

@@ -54,6 +54,10 @@ class DNNLearner(Estimator, HasFeaturesCol, HasLabelCol):
         "dispatch overhead on high-latency links)", 1, ptype=int,
         validator=positive,
     )
+    remat = Param(
+        "recompute forward in backward (activation-memory saver)", False,
+        ptype=bool,
+    )
     mesh_axes = Param("mesh axis name -> size; None = all-devices DP")
     checkpoint_dir = Param("orbax checkpoint directory (None = off)")
     checkpoint_every = Param("checkpoint every N steps (0 = end only)", 0,
@@ -74,6 +78,7 @@ class DNNLearner(Estimator, HasFeaturesCol, HasLabelCol):
             seed=self.seed,
             shuffle=self.shuffle,
             steps_per_dispatch=self.steps_per_dispatch,
+            remat=self.remat,
             mesh_axes=self.mesh_axes,
             checkpoint_dir=self.checkpoint_dir,
             checkpoint_every=self.checkpoint_every,

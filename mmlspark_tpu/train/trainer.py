@@ -62,6 +62,10 @@ class TrainConfig:
     # chunk run through the single-step program. Ignored (forced 1) under
     # tensor-parallel param_rules.
     steps_per_dispatch: int = 1
+    # rematerialize the forward pass in the backward (jax.checkpoint):
+    # trades ~33% more FLOPs for not keeping activations in HBM — the
+    # standard lever when activation memory, not compute, caps batch size
+    remat: bool = False
     # weight on sown auxiliary losses (e.g. MoE load-balance, models/moe.py)
     moe_aux_weight: float = 1e-2
     # mesh: axis name -> size; None = all devices on the data axis
@@ -254,12 +258,19 @@ class SPMDTrainer:
 
         takes_mask = "mask" in inspect.signature(graph.apply).parameters
 
+        def fwd(variables, bx, bmask):
+            mask_kw = {"mask": bmask} if takes_mask else {}
+            return graph.apply(variables, bx, train=True, **mask_kw)
+
+        if cfg.remat:
+            # recompute the forward during the backward instead of holding
+            # activations in HBM
+            fwd = jax.checkpoint(fwd)
+
         def step_fn(params, rest, opt_state, bx, by, bmask):
             def loss_fn(p):
                 variables = _merge_variables(p, rest)
-                mask_kw = {"mask": bmask} if takes_mask else {}
-                out, updated = graph.apply(variables, bx, train=True,
-                                           **mask_kw)
+                out, updated = fwd(variables, bx, bmask)
                 loss = masked_loss(loss_kind, out, by, bmask)
                 loss = loss + aux_w * _sown_aux_loss(updated)
                 _, new_rest = _split_variables(updated)

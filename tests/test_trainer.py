@@ -175,3 +175,28 @@ def test_steps_per_dispatch_exactness():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_remat_is_semantics_preserving():
+    """jax.checkpoint trades FLOPs for memory; final params must match the
+    non-remat run exactly."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.int32)
+    graph = build_model("mlp", num_outputs=2, hidden=(8,))
+
+    def run(remat):
+        tr = SPMDTrainer(
+            graph,
+            TrainConfig(epochs=2, batch_size=16, learning_rate=1e-2,
+                        remat=remat, seed=5),
+        )
+        return tr.train(x, y)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(run(False)),
+        jax.tree_util.tree_leaves(run(True)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
