@@ -37,18 +37,33 @@ def make_flights(n=800, seed=3) -> Dataset:
 
 
 def main():
+    from mmlspark_tpu.stages.find_best import FindBestModel
+
     train, test = make_flights(seed=3), make_flights(n=250, seed=4)
-    model = TrainRegressor(
-        label_col="arr_delay", epochs=120, learning_rate=5e-2
-    ).fit(train)
-    scored = model.transform(test)
+    # the notebook trains linear + tree-family regressors (each with its
+    # own knobs) and compares; rank with FindBestModel like its
+    # evaluation cells
+    configs = [
+        dict(model="linear_regression", epochs=120, learning_rate=5e-2),
+        dict(model="gbt", max_iter=60),
+        dict(model="random_forest", num_trees=30),
+    ]
+    candidates = [
+        TrainRegressor(label_col="arr_delay", **cfg).fit(train)
+        for cfg in configs
+    ]
+    best = FindBestModel(models=candidates, evaluation_metric="R^2").fit(
+        test
+    )
+    scored = best.best_model.transform(test)
     stats = ComputeModelStatistics().transform(scored)
     r2 = float(stats["R^2"][0])
     rmse = float(stats["root_mean_squared_error"][0])
     per = ComputePerInstanceStatistics().transform(scored)
     assert r2 > 0.5, f"R^2 {r2} too low"
     assert per["L2_loss"].min() >= 0
-    print(f"OK {{'R^2': {r2:.3f}, 'RMSE': {rmse:.2f}}}")
+    print(f"OK {{'R^2': {r2:.3f}, 'RMSE': {rmse:.2f}, "
+          f"'candidates': {len(best.all_model_metrics)}}}")
 
 
 if __name__ == "__main__":
