@@ -172,6 +172,7 @@ def _best_split_xgb(
     return (
         jnp.where(ok, feat, 0),
         jnp.where(ok, thresh, max_bins),  # sentinel: everything goes left
+        jnp.where(ok, jnp.maximum(best_gain, 0.0), 0.0),
     )
 
 
@@ -203,7 +204,11 @@ def _best_split_gini(hist, feat_mask, max_bins: int, min_child, min_gain):
     # data every root split has exactly zero gain and refusing would freeze
     # the tree at depth 0
     ok = best_gain >= min_gain
-    return jnp.where(ok, feat, 0), jnp.where(ok, thresh, max_bins)
+    return (
+        jnp.where(ok, feat, 0),
+        jnp.where(ok, thresh, max_bins),
+        jnp.where(ok, jnp.maximum(best_gain, 0.0), 0.0),
+    )
 
 
 @jax.jit
@@ -232,33 +237,41 @@ def _build_tree(
     min_child: float = 1.0,
     min_gain: float = 0.0,
 ):
-    """One histogram tree. Returns (feat [2^L], thresh [2^L], leaf stat sums
-    [2^L, s]) as device arrays; leaf VALUES are derived by the caller
-    (criterion-specific)."""
-    n = bins.shape[0]
+    """One histogram tree. Returns (feat [2^L], thresh [2^L], leaf stat
+    sums [2^L, s], raw per-feature split-gain sums [d]) — all device
+    arrays; leaf VALUES and importance normalization are derived by the
+    caller (criterion-specific)."""
+    n, d = bins.shape
     heap = 1 << max_depth
     feat = jnp.zeros(heap, jnp.int32)
     thresh = jnp.full(heap, max_bins, jnp.int32)
     node = jnp.ones(n, jnp.int32)
+    importance = jnp.zeros(d, jnp.float32)
     for level in range(max_depth):
         base = 1 << level
         hist = _level_histogram(bins, stats, node - base, base, max_bins)
         if criterion == "xgb":
-            f, t = _best_split_xgb(
+            f, t, g = _best_split_xgb(
                 hist, feat_mask, max_bins,
                 jnp.float32(lam), jnp.float32(min_child),
                 jnp.float32(min_gain),
             )
         else:
-            f, t = _best_split_gini(
+            f, t, g = _best_split_gini(
                 hist, feat_mask, max_bins,
                 jnp.float32(min_child), jnp.float32(min_gain),
             )
+        # per-feature split-gain accumulation stays ON DEVICE (a host
+        # fetch here would sync every level and break async dispatch);
+        # sentinel (no-split) nodes contribute zero
+        importance = importance.at[f].add(
+            jnp.where(t < max_bins, g, 0.0)
+        )
         feat = jax.lax.dynamic_update_slice(feat, f, (base,))
         thresh = jax.lax.dynamic_update_slice(thresh, t, (base,))
         node = _route(bins, node, feat, thresh)
     leaves = _leaf_stats(stats, node - heap, heap)
-    return feat, thresh, leaves
+    return feat, thresh, leaves, importance
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -357,12 +370,29 @@ def _feature_subset_mask(d, strategy, rng):
     return mask
 
 
+def _normalize_importance(imp: np.ndarray) -> np.ndarray:
+    total = imp.sum()
+    return imp / total if total > 0 else imp
+
+
+def _accumulate_importance(importance: np.ndarray, tree_imp) -> None:
+    """Spark featureImportances semantics: each tree's vector normalizes
+    to 1 BEFORE averaging, so every tree votes equally regardless of its
+    absolute gain scale."""
+    importance += _normalize_importance(np.asarray(tree_imp, np.float64))
+
+
 class _FittedTreeBase(Model, HasFeaturesCol, HasOutputCol):
     """Shared transform path: bin with saved edges, run the gather chain."""
 
     _abstract = True
 
     edges = Param("per-feature quantile bin edges [d, B-1]")
+    feature_importances = Param(
+        "per-feature importance: each tree's split gains normalized to "
+        "sum 1, averaged across trees (Spark featureImportances "
+        "semantics), renormalized"
+    )
     feats = Param("split feature per heap node, [T, 2^L]")
     threshs = Param("split threshold bin per heap node, [T, 2^L]")
     values = Param("leaf values, [T, 2^L, V]")
@@ -464,6 +494,7 @@ class DecisionTreeClassifier(
         onehot = jnp.asarray(np.eye(k, dtype=np.float32)[y])
         rng = np.random.default_rng(self.seed)
         feats, threshs, values = [], [], []
+        importance = np.zeros(x.shape[1], np.float64)
         for _ in range(self.num_trees):
             w = (
                 rng.poisson(1.0, size=len(y)).astype(np.float32)
@@ -473,7 +504,7 @@ class DecisionTreeClassifier(
             mask = jnp.asarray(
                 _feature_subset_mask(x.shape[1], self.feature_subset, rng)
             )
-            f, t, leaves = _build_tree(
+            f, t, leaves, imp = _build_tree(
                 bins,
                 onehot * jnp.asarray(w)[:, None],
                 criterion="gini",
@@ -492,6 +523,7 @@ class DecisionTreeClassifier(
             feats.append(np.asarray(f))
             threshs.append(np.asarray(t))
             values.append(np.asarray(probs, np.float32))
+            _accumulate_importance(importance, imp)
         return TreeClassifierModel(
             edges=edges,
             feats=np.stack(feats),
@@ -499,6 +531,7 @@ class DecisionTreeClassifier(
             values=np.stack(values),
             max_depth=self.max_depth,
             features_col=self.features_col,
+            feature_importances=_normalize_importance(importance),
         )
 
 
@@ -537,6 +570,7 @@ class DecisionTreeRegressor(
         bins = jnp.asarray(bin_features(x, edges))
         rng = np.random.default_rng(self.seed)
         feats, threshs, values = [], [], []
+        importance = np.zeros(x.shape[1], np.float64)
         for _ in range(self.num_trees):
             w = (
                 rng.poisson(1.0, size=len(y)).astype(np.float32)
@@ -551,7 +585,7 @@ class DecisionTreeRegressor(
             stats = jnp.stack(
                 [jnp.asarray(-y * w), jnp.asarray(w), jnp.asarray(w)], axis=1
             )
-            f, t, leaves = _build_tree(
+            f, t, leaves, imp = _build_tree(
                 bins,
                 stats,
                 criterion="xgb",
@@ -566,6 +600,7 @@ class DecisionTreeRegressor(
             feats.append(np.asarray(f))
             threshs.append(np.asarray(t))
             values.append(np.asarray(val, np.float32))
+            _accumulate_importance(importance, imp)
         return TreeRegressorModel(
             edges=edges,
             feats=np.stack(feats),
@@ -573,6 +608,7 @@ class DecisionTreeRegressor(
             values=np.stack(values),
             max_depth=self.max_depth,
             features_col=self.features_col,
+            feature_importances=_normalize_importance(importance),
         )
 
 
@@ -612,6 +648,7 @@ class GBTClassifier(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
         )
         mask = jnp.ones(x.shape[1], bool)
         feats, threshs, values = [], [], []
+        importance = np.zeros(x.shape[1], np.float64)
         ones = jnp.ones(len(y), jnp.float32)
         for _ in range(self.max_iter):
             p = jax.nn.softmax(margins, axis=1)
@@ -621,7 +658,7 @@ class GBTClassifier(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
             f = t = None
             for c in range(k):
                 stats = jnp.stack([g[:, c], h[:, c], ones], axis=1)
-                f, t, leaves = _build_tree(
+                f, t, leaves, imp = _build_tree(
                     bins,
                     stats,
                     criterion="xgb",
@@ -644,6 +681,7 @@ class GBTClassifier(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
                 v = np.zeros((val.shape[0], k), np.float32)
                 v[:, c] = np.asarray(val)
                 round_vals.append(v)
+                _accumulate_importance(importance, imp)
             values.extend(round_vals)
         return GBTClassifierModel(
             edges=edges,
@@ -654,6 +692,7 @@ class GBTClassifier(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
             step_size=self.step_size,
             base=prior,
             features_col=self.features_col,
+            feature_importances=_normalize_importance(importance),
         )
 
 
@@ -674,10 +713,11 @@ class GBTRegressor(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
         ones = jnp.ones(len(y), jnp.float32)
         mask = jnp.ones(x.shape[1], bool)
         feats, threshs, values = [], [], []
+        importance = np.zeros(x.shape[1], np.float64)
         for _ in range(self.max_iter):
             g = pred - yj  # d/dF of 0.5*(F - y)^2
             stats = jnp.stack([g, ones, ones], axis=1)
-            f, t, leaves = _build_tree(
+            f, t, leaves, imp = _build_tree(
                 bins,
                 stats,
                 criterion="xgb",
@@ -696,6 +736,7 @@ class GBTRegressor(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
             feats.append(np.asarray(f))
             threshs.append(np.asarray(t))
             values.append(np.asarray(val[:, None], np.float32))
+            _accumulate_importance(importance, imp)
         return GBTRegressorModel(
             edges=edges,
             feats=np.stack(feats),
@@ -705,4 +746,5 @@ class GBTRegressor(Estimator, _TreeParams, HasFeaturesCol, HasLabelCol):
             step_size=self.step_size,
             base=base,
             features_col=self.features_col,
+            feature_importances=_normalize_importance(importance),
         )

@@ -366,3 +366,25 @@ def test_negative_labels_rejected():
     ds = Dataset({"features": x, "label": y})
     with pytest.raises(Exception, match=r"\[0, k\)"):
         DecisionTreeClassifier(label_col="label").fit(ds)
+
+
+def test_feature_importances(tmp_path):
+    """Split-gain importances: the informative features dominate, the
+    vector is normalized, and it persists through save/load."""
+    ds = xor_ds(n=500)
+    model = GBTClassifier(label_col="label", max_iter=5, max_depth=3).fit(ds)
+    imp = np.asarray(model.feature_importances)
+    assert imp.shape == (6,)
+    np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-6)
+    assert imp[0] + imp[1] > 0.8  # x0/x1 carry the signal
+    model.save(str(tmp_path / "m"))
+    loaded = PipelineStage.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        np.asarray(loaded.feature_importances), imp
+    )
+
+    reg = DecisionTreeRegressor(label_col="label", max_depth=4).fit(
+        reg_ds()
+    )
+    rimp = np.asarray(reg.feature_importances)
+    assert rimp[0] + rimp[1] > 0.9
