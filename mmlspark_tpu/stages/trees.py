@@ -263,10 +263,8 @@ def _build_tree(
             )
         # per-feature split-gain accumulation stays ON DEVICE (a host
         # fetch here would sync every level and break async dispatch);
-        # sentinel (no-split) nodes contribute zero
-        importance = importance.at[f].add(
-            jnp.where(t < max_bins, g, 0.0)
-        )
+        # sentinel (no-split) nodes already carry zero gain
+        importance = importance.at[f].add(g)
         feat = jax.lax.dynamic_update_slice(feat, f, (base,))
         thresh = jax.lax.dynamic_update_slice(thresh, t, (base,))
         node = _route(bins, node, feat, thresh)

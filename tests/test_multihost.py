@@ -73,6 +73,14 @@ def test_two_process_psum(tmp_path):
     # tunnel inside each worker; multi-host CPU must not depend on it
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # the worker script lives in tmp_path, so python puts tmp_path (not our
+    # cwd) on sys.path — the repo root must be importable even when the
+    # package isn't pip-installed in this interpreter
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    existing = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + existing if existing else repo_root
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), coord, str(pid)],
