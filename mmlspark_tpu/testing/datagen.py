@@ -134,3 +134,29 @@ def blob_images(n: int, seed: int, classes: int = 2):
         img[half] += 150
         imgs.append(np.clip(img, 0, 255).astype(np.uint8))
     return imgs, y
+
+
+def bar_images(n: int, seed: int):
+    """Orientation classes — one bright 3x11 bar, vertical vs horizontal,
+    at a RANDOM position on a noisy background (32x32 uint8 HWC).
+
+    Position randomness (each axis ranges over the full extent its bar
+    dimension allows) keeps raw-pixel marginals nearly class-independent,
+    so a convolutional featurizer genuinely beats the resize+unroll
+    "basic" path — the comparison notebook 305 stages. Source for the
+    ResNet20_Bars zoo payload (tools/publish_zoo.py) and the e305
+    example. Returns (list of HWC uint8 arrays, labels).
+    """
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    imgs = []
+    for label in y:
+        img = rng.integers(0, 90, (32, 32, 3))
+        long_pos = int(rng.integers(0, 32 - 11))
+        short_pos = int(rng.integers(0, 32 - 3))
+        if label == 0:  # vertical bar: long axis is rows
+            img[long_pos : long_pos + 11, short_pos : short_pos + 3] += 140
+        else:  # horizontal bar: long axis is columns
+            img[short_pos : short_pos + 3, long_pos : long_pos + 11] += 140
+        imgs.append(np.clip(img, 0, 255).astype(np.uint8))
+    return imgs, y

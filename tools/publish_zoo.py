@@ -23,42 +23,68 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ZOO = os.path.join(REPO, "models", "zoo_repo")
 
 
-def main() -> None:
-    sys.path.insert(0, REPO)
+def _train_and_publish(name, make_data, epochs, lr) -> None:
     from mmlspark_tpu.models import build_model
-    from mmlspark_tpu.testing.datagen import blob_images
     from mmlspark_tpu.models.zoo import publish_model
     from mmlspark_tpu.stages.dnn_model import TPUModel
     from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
 
     graph = build_model("resnet20_cifar10", width=8)
-    imgs, y = blob_images(256, seed=0)
+    imgs, y = make_data(256, seed=0)
     x = np.stack(imgs).astype(np.float32) / 255.0
     trainer = SPMDTrainer(
         graph,
-        TrainConfig(epochs=15, batch_size=64, learning_rate=1e-2,
+        TrainConfig(epochs=epochs, batch_size=64, learning_rate=lr,
                     log_every=20),
     )
     variables = trainer.train(x, y.astype(np.int32))
+    # held-out gate: a degenerate backbone must not reach the committed zoo
+    h_imgs, h_y = make_data(128, seed=999)
+    hx = np.stack(h_imgs).astype(np.float32) / 255.0
+    pred = np.asarray(graph.apply(variables, hx)).argmax(axis=1)
+    acc = float((pred == h_y).mean())
+    assert acc > 0.9, f"{name}: held-out accuracy {acc} too low to publish"
     stage = TPUModel.from_graph(
         graph, variables, "resnet20_cifar10", model_config={"width": 8},
         input_col="image", output_col="scores",
     )
     with tempfile.TemporaryDirectory() as tmp:
-        payload = os.path.join(tmp, "resnet20_blobs")
+        payload = os.path.join(tmp, name.lower())
         stage.save(payload)
         schema = publish_model(
             ZOO,
-            "ResNet20_Blobs",
+            name,
             payload,
             input_node="image",
             layer_names=tuple(graph.layer_names),
-            dataset="synthetic-blobs",
+            dataset=f"synthetic-{name.split('_')[-1].lower()}",
             model_type="image-classifier",
             extra={"width": 8, "input_scale": "1/255"},
         )
     print(f"published {schema.name} -> {ZOO} (sha256 {schema.hash[:12]}…, "
-          f"{schema.size} bytes)")
+          f"{schema.size} bytes, held-out acc {acc:.3f})")
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from mmlspark_tpu.testing.datagen import bar_images, blob_images
+
+    specs = {
+        "ResNet20_Blobs": (blob_images, 15, 1e-2),
+        # bars: position-invariant orientation — the conv-vs-raw-pixel
+        # comparison backbone for e305
+        "ResNet20_Bars": (bar_images, 40, 1e-2),
+    }
+    # republish only the named models (training is not bit-reproducible,
+    # so an unfiltered run would churn every committed payload)
+    selected = sys.argv[1:] or list(specs)
+    for name in selected:
+        if name not in specs:
+            raise SystemExit(
+                f"unknown model {name!r}; valid names: {', '.join(specs)}"
+            )
+        make_data, epochs, lr = specs[name]
+        _train_and_publish(name, make_data, epochs=epochs, lr=lr)
 
 
 if __name__ == "__main__":
