@@ -64,6 +64,12 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
+def _full_scale(jax) -> bool:
+    """TPU runs at full size; other backends (CPU smoke) run tiny so the
+    whole bench stays inside a smoke-test budget. The JSON records which."""
+    return jax.default_backend() == "tpu"
+
+
 def bench_inference(jax, jnp) -> dict:
     """Images/sec/chip + MFU for ResNet-20 CIFAR inference."""
     from mmlspark_tpu.models import build_model
@@ -72,13 +78,13 @@ def bench_inference(jax, jnp) -> dict:
     rng = jax.random.PRNGKey(0)
     variables = graph.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32))
 
-    batch = 1024
+    batch = 1024 if _full_scale(jax) else 128
     x_host = np.random.default_rng(0).normal(size=(batch, 32, 32, 3))
     # feed bfloat16: the model computes in bf16 regardless (MXU-native;
     # logits stay f32), so an f32 input buffer only adds transfer bytes
     x = jnp.asarray(x_host, jnp.bfloat16)
 
-    iters = 60
+    iters = 60 if _full_scale(jax) else 4
 
     # Methodology: iterations chained by a data dependency inside ONE jit
     # (so no execution can be elided or overlapped away), timed around a
@@ -143,8 +149,45 @@ def bench_inference(jax, jnp) -> dict:
         "device_kind": kind,
         "peak_bf16_flops": peak,
         "batch": batch,
+        "iters": iters,
         "input_dtype": "bfloat16",
-        "timing": "best-of-3 trials, 60 scan-chained iters, host-fetch sync",
+        "timing": "best-of-3 trials, scan-chained iters, host-fetch sync",
+    }
+
+
+def bench_stage_inference(jax) -> dict:
+    """Images/sec through the full TPUModel STAGE — host coercion, async
+    host->HBM feed, compute, masked fetch. The product path that replaces
+    the reference's per-minibatch JNI copy->evaluate->copy hot loop
+    (CNTKModel.scala:51-88); the model-only number above is its ceiling."""
+    from mmlspark_tpu.data.dataset import Dataset
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.stages.dnn_model import TPUModel
+
+    import jax.numpy as jnp
+
+    graph = build_model("resnet20_cifar10")
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
+    )
+    batch = 1024 if _full_scale(jax) else 128
+    stage = TPUModel.from_graph(
+        graph, variables, "resnet20_cifar10",
+        input_col="image", output_col="scores", batch_size=batch,
+    )
+    n = 16384 if _full_scale(jax) else 512
+    x = np.random.default_rng(1).normal(size=(n, 32, 32, 3)).astype(
+        np.float32
+    )
+    ds = Dataset({"image": x})
+    stage.transform(ds)  # warmup: compile + weight put
+    dt = min(_timed(lambda: stage.transform(ds)) for _ in range(3))
+    return {
+        "stage_images_per_sec_per_chip": round(
+            n / dt / jax.device_count(), 1
+        ),
+        "stage_batch_size": batch,
+        "stage_rows": n,
     }
 
 
@@ -154,7 +197,7 @@ def bench_train_classifier(jax) -> dict:
     from mmlspark_tpu.stages.train_classifier import TrainClassifier
     from mmlspark_tpu.testing.datagen import make_census
 
-    n = 32561
+    n = 32561 if _full_scale(jax) else 2048
     ds = make_census(n, seed=7, full_schema=True)
 
     def fit(epochs: int) -> float:
@@ -179,11 +222,20 @@ def bench_train_classifier(jax) -> dict:
 
 
 def run() -> dict:
-    import jax
-    import jax.numpy as jnp
+    watchdog = _init_watchdog(float(os.environ.get(
+        "MMLTPU_BENCH_INIT_TIMEOUT_S", "240"
+    )))
+    try:
+        import jax
+        import jax.numpy as jnp
 
-    jax.devices()  # force backend init inside the retry envelope
+        jax.devices()  # force backend init inside the retry envelope
+    finally:
+        # cancel on BOTH paths: a raising init must reach the re-exec
+        # retry envelope, not be shot mid-backoff with a bogus "hung"
+        watchdog.cancel()
     inf = bench_inference(jax, jnp)
+    stage = bench_stage_inference(jax)
     train = bench_train_classifier(jax)
     return {
         "metric": "cifar10_resnet20_inference_images_per_sec_per_chip",
@@ -193,8 +245,35 @@ def run() -> dict:
         "devices": jax.device_count(),
         "backend": jax.default_backend(),
         **inf,
+        **stage,
         **train,
     }
+
+
+def _init_watchdog(seconds: float):
+    """Backend init can HANG (wedged relay/tunnel), not just raise — and a
+    hang would leave the driver with no JSON at its own timeout. A daemon
+    timer guarantees the diagnostic line; cancel() it once init returns."""
+    import threading
+
+    def fire():
+        print(
+            json.dumps({
+                "metric":
+                    "cifar10_resnet20_inference_images_per_sec_per_chip",
+                "value": None,
+                "unit": "images/sec/chip",
+                "vs_baseline": None,
+                "error": f"backend init hung for {seconds:.0f}s (watchdog)",
+            }),
+            flush=True,
+        )
+        os._exit(7)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def main() -> None:

@@ -76,8 +76,14 @@ def main() -> None:
         "ResNet20_Bars": (bar_images, 40, 1e-2),
     }
     # republish only the named models (training is not bit-reproducible,
-    # so an unfiltered run would churn every committed payload)
-    selected = sys.argv[1:] or list(specs)
+    # so republishing everything churns every committed payload); the
+    # all-models run is opt-in via an explicit "all"
+    if not sys.argv[1:]:
+        raise SystemExit(
+            "name the model(s) to republish, or 'all' for every one of: "
+            + ", ".join(specs)
+        )
+    selected = list(specs) if sys.argv[1:] == ["all"] else sys.argv[1:]
     for name in selected:
         if name not in specs:
             raise SystemExit(
