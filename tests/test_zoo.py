@@ -142,3 +142,35 @@ def test_http_download_rejects_path_traversal(tmp_path, remote_repo):
         assert not (tmp_path / "evil.txt").exists()
     finally:
         server.shutdown()
+
+
+# -- committed payload integrity ------------------------------------------
+
+_ZOO_REPO = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "models", "zoo_repo",
+)
+
+
+@pytest.mark.parametrize(
+    "name,datagen",
+    [("ResNet20_Blobs", "blob_images"), ("ResNet20_Bars", "bar_images")],
+)
+def test_committed_payload_scores(tmp_path, name, datagen):
+    """Every payload committed under models/zoo_repo must download through
+    the sha256-verified path, load, and still separate its own data
+    distribution — catching payload/datagen drift at unit-test speed
+    rather than in the example tier."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.testing import datagen as dg
+
+    downloader = ModelDownloader(str(tmp_path), remote=_ZOO_REPO)
+    schema = downloader.download_by_name(name)
+    assert schema.layer_names, "committed payloads must carry layer names"
+    stage = PipelineStage.load(downloader.local_path(schema))
+
+    imgs, y = getattr(dg, datagen)(96, seed=123)
+    x = np.stack(imgs).astype(np.float32) / 255.0
+    scored = stage.transform(Dataset({"image": x}))
+    acc = float((np.asarray(scored["scores"]).argmax(1) == y).mean())
+    assert acc > 0.9, f"{name} committed payload scores {acc} on {datagen}"

@@ -8,7 +8,8 @@ this script trains the e303 backbone and publishes it (payload + .meta +
 MANIFEST + .files sidecar) so examples exercise the real
 ``ModelDownloader.download_by_name`` path, sha256 verification included.
 
-Run: ``python tools/publish_zoo.py`` (idempotent; regenerates in place).
+Run: ``python tools/publish_zoo.py <Name ...>`` (or ``all``) — retrains
+and republishes the named payloads in place; all-models churn is opt-in.
 """
 
 from __future__ import annotations
