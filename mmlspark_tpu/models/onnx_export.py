@@ -247,6 +247,237 @@ def _export_bilstm_tagger(variables, sample_shape):
     )
 
 
+def _ln_nodes(prefix, x_name, out_name, nodes, inits, scale, bias):
+    """Decompose a LayerNorm over the last axis into primitive ONNX ops
+    (ReduceMean/Sub/Mul/Sqrt/Div) so the graph needs no opset-17 fused op;
+    matches flax nn.LayerNorm (biased variance, eps 1e-6)."""
+    p = prefix
+    inits += [
+        tensor_proto(f"{p}_scale", scale),
+        tensor_proto(f"{p}_bias", bias),
+    ]
+    red = [attr_ints("axes", [-1]), attr_i("keepdims", 1)]
+    nodes += [
+        node("ReduceMean", [x_name], [f"{p}_mu"], name=f"{p}_mu",
+             attrs=red),
+        node("Sub", [x_name, f"{p}_mu"], [f"{p}_c"], name=f"{p}_c"),
+        node("Mul", [f"{p}_c", f"{p}_c"], [f"{p}_c2"], name=f"{p}_c2"),
+        node("ReduceMean", [f"{p}_c2"], [f"{p}_var"], name=f"{p}_var",
+             attrs=red),
+        node("Add", [f"{p}_var", "ln_eps"], [f"{p}_ve"], name=f"{p}_ve"),
+        node("Sqrt", [f"{p}_ve"], [f"{p}_sd"], name=f"{p}_sd"),
+        node("Div", [f"{p}_c", f"{p}_sd"], [f"{p}_n"], name=f"{p}_n"),
+        node("Mul", [f"{p}_n", f"{p}_scale"], [f"{p}_ns"], name=f"{p}_ns"),
+        node("Add", [f"{p}_ns", f"{p}_bias"], [out_name], name=out_name),
+    ]
+
+
+def _gelu_nodes(prefix, x_name, out_name, nodes):
+    """tanh-approximate gelu (flax nn.gelu default):
+    0.5·x·(1 + tanh(√(2/π)·(x + 0.044715·x³)))."""
+    p = prefix
+    nodes += [
+        node("Mul", [x_name, x_name], [f"{p}_x2"], name=f"{p}_x2"),
+        node("Mul", [f"{p}_x2", x_name], [f"{p}_x3"], name=f"{p}_x3"),
+        node("Mul", [f"{p}_x3", "gelu_c0"], [f"{p}_cx3"], name=f"{p}_cx3"),
+        node("Add", [x_name, f"{p}_cx3"], [f"{p}_in"], name=f"{p}_in"),
+        node("Mul", [f"{p}_in", "gelu_c1"], [f"{p}_si"], name=f"{p}_si"),
+        node("Tanh", [f"{p}_si"], [f"{p}_t"], name=f"{p}_t"),
+        node("Add", [f"{p}_t", "one"], [f"{p}_t1"], name=f"{p}_t1"),
+        node("Mul", [x_name, f"{p}_t1"], [f"{p}_xt"], name=f"{p}_xt"),
+        node("Mul", [f"{p}_xt", "half"], [out_name], name=out_name),
+    ]
+
+
+def _export_transformer_lm(graph, variables, sample_shape):
+    """Decoder/encoder transformer -> primitive-op ONNX. Block outputs are
+    named ``block{i}`` and the logits node ``z`` (= graph.layer_names), so
+    the importer's named-node cut works exactly as on the flax graph."""
+    batch, seq = sample_shape
+    extra = graph.extra
+    causal = bool(extra.get("causal", True))
+    emb = _np(variables["embed"], "params", "token", "embedding")
+    pos = _np(variables["embed"], "params", "pos")[:seq]
+    d_model = emb.shape[1]
+    blocks = [n for n in graph.layer_names if n.startswith("block")]
+    if not blocks:
+        raise FriendlyError("transformer_lm export needs depth >= 1")
+    # head count: qkv kernel is (E, 3·H·D) with E = H·D
+    hd3 = _np(
+        variables[blocks[0]], "params", "attn", "qkv", "kernel"
+    ).shape[1]
+    if hd3 != 3 * d_model:
+        raise FriendlyError(
+            f"qkv kernel must be (E, 3E); got 3HD={hd3} for E={d_model}"
+        )
+    heads = int(extra.get("heads", 0))
+    if not heads:
+        raise FriendlyError(
+            "transformer_lm export needs the head count in graph.extra"
+        )
+    head_dim = d_model // heads
+
+    nodes, inits = [], []
+    inits += [
+        tensor_proto("embedding", emb),
+        tensor_proto("pos", pos),
+        tensor_proto("ln_eps", np.array(1e-6, np.float32)),
+        tensor_proto("one", np.array(1.0, np.float32)),
+        tensor_proto("half", np.array(0.5, np.float32)),
+        tensor_proto("gelu_c0", np.array(0.044715, np.float32)),
+        tensor_proto(
+            "gelu_c1", np.array(np.sqrt(2.0 / np.pi), np.float32)
+        ),
+        tensor_proto(
+            "attn_scale", np.array(1.0 / np.sqrt(head_dim), np.float32)
+        ),
+        tensor_proto(
+            "shape_split",
+            np.array([batch, seq, heads, head_dim], np.int64),
+        ),
+        tensor_proto(
+            "shape_merge", np.array([batch, seq, d_model], np.int64)
+        ),
+        tensor_proto("sl_axes", np.array([2], np.int64)),
+    ]
+    if causal:
+        # the (T, T) additive mask is synthesized IN-GRAPH from two O(T)
+        # position vectors — clip(relu(j - i), 0, 1) is exactly 1 above
+        # the diagonal for integer-valued positions — so the exported
+        # payload stays linear in sequence length
+        ar = np.arange(seq, dtype=np.float32)
+        inits += [
+            tensor_proto("pos_row", ar.reshape(seq, 1)),
+            tensor_proto("pos_col", ar.reshape(1, seq)),
+            tensor_proto("zero", np.array(0.0, np.float32)),
+            tensor_proto("neg_big", np.array(-1e9, np.float32)),
+        ]
+        nodes += [
+            node("Sub", ["pos_col", "pos_row"], ["mask_d"], name="mask_d"),
+            node("Relu", ["mask_d"], ["mask_r"], name="mask_r"),
+            node("Clip", ["mask_r", "zero", "one"], ["mask_c"],
+                 name="mask_c"),
+            node("Mul", ["mask_c", "neg_big"], ["causal_mask"],
+                 name="causal_mask"),
+        ]
+
+    nodes += [
+        node("Gather", ["embedding", "x"], ["tok"], name="tok",
+             attrs=[attr_i("axis", 0)]),
+        node("Add", ["tok", "pos"], ["embed"], name="embed"),
+    ]
+    prev = "embed"
+    for bi, blk in enumerate(blocks):
+        params = variables[blk]["params"]
+        p = blk
+        _ln_nodes(f"{p}_ln1", prev, f"{p}_y1", nodes, inits,
+                  _np(params, "ln1", "scale"), _np(params, "ln1", "bias"))
+        # qkv projection + per-head split (q|k|v are contiguous thirds)
+        inits += [
+            tensor_proto(f"{p}_qkv_w", _np(params, "attn", "qkv", "kernel")),
+            tensor_proto(f"{p}_qkv_b", _np(params, "attn", "qkv", "bias")),
+            tensor_proto(f"{p}_ao_w",
+                         _np(params, "attn", "attn_out", "kernel")),
+            tensor_proto(f"{p}_ao_b",
+                         _np(params, "attn", "attn_out", "bias")),
+        ]
+        nodes += [
+            node("MatMul", [f"{p}_y1", f"{p}_qkv_w"], [f"{p}_qkv0"],
+                 name=f"{p}_qkv0"),
+            node("Add", [f"{p}_qkv0", f"{p}_qkv_b"], [f"{p}_qkv"],
+                 name=f"{p}_qkv"),
+        ]
+        for j, nm in enumerate(("q", "k", "v")):
+            lo, hi = j * d_model, (j + 1) * d_model
+            inits += [
+                tensor_proto(f"{p}_{nm}_st", np.array([lo], np.int64)),
+                tensor_proto(f"{p}_{nm}_en", np.array([hi], np.int64)),
+            ]
+            nodes += [
+                node("Slice",
+                     [f"{p}_qkv", f"{p}_{nm}_st", f"{p}_{nm}_en",
+                      "sl_axes"],
+                     [f"{p}_{nm}f"], name=f"{p}_{nm}f"),
+                node("Reshape", [f"{p}_{nm}f", "shape_split"],
+                     [f"{p}_{nm}s"], name=f"{p}_{nm}s"),
+            ]
+        nodes += [
+            node("Transpose", [f"{p}_qs"], [f"{p}_qh"], name=f"{p}_qh",
+                 attrs=[attr_ints("perm", [0, 2, 1, 3])]),
+            node("Transpose", [f"{p}_ks"], [f"{p}_kT"], name=f"{p}_kT",
+                 attrs=[attr_ints("perm", [0, 2, 3, 1])]),
+            node("Transpose", [f"{p}_vs"], [f"{p}_vh"], name=f"{p}_vh",
+                 attrs=[attr_ints("perm", [0, 2, 1, 3])]),
+            node("MatMul", [f"{p}_qh", f"{p}_kT"], [f"{p}_sc0"],
+                 name=f"{p}_sc0"),
+            node("Mul", [f"{p}_sc0", "attn_scale"], [f"{p}_sc"],
+                 name=f"{p}_sc"),
+        ]
+        score = f"{p}_sc"
+        if causal:
+            nodes.append(node("Add", [score, "causal_mask"],
+                              [f"{p}_scm"], name=f"{p}_scm"))
+            score = f"{p}_scm"
+        nodes += [
+            node("Softmax", [score], [f"{p}_pr"], name=f"{p}_pr",
+                 attrs=[attr_i("axis", -1)]),
+            node("MatMul", [f"{p}_pr", f"{p}_vh"], [f"{p}_ctx"],
+                 name=f"{p}_ctx"),
+            node("Transpose", [f"{p}_ctx"], [f"{p}_ctxT"],
+                 name=f"{p}_ctxT",
+                 attrs=[attr_ints("perm", [0, 2, 1, 3])]),
+            node("Reshape", [f"{p}_ctxT", "shape_merge"], [f"{p}_ctxm"],
+                 name=f"{p}_ctxm"),
+            node("MatMul", [f"{p}_ctxm", f"{p}_ao_w"], [f"{p}_ao0"],
+                 name=f"{p}_ao0"),
+            node("Add", [f"{p}_ao0", f"{p}_ao_b"], [f"{p}_ao"],
+                 name=f"{p}_ao"),
+            node("Add", [prev, f"{p}_ao"], [f"{p}_res1"],
+                 name=f"{p}_res1"),
+        ]
+        _ln_nodes(f"{p}_ln2", f"{p}_res1", f"{p}_y2", nodes, inits,
+                  _np(params, "ln2", "scale"), _np(params, "ln2", "bias"))
+        inits += [
+            tensor_proto(f"{p}_mi_w", _np(params, "mlp_in", "kernel")),
+            tensor_proto(f"{p}_mi_b", _np(params, "mlp_in", "bias")),
+            tensor_proto(f"{p}_mo_w", _np(params, "mlp_out", "kernel")),
+            tensor_proto(f"{p}_mo_b", _np(params, "mlp_out", "bias")),
+        ]
+        nodes += [
+            node("MatMul", [f"{p}_y2", f"{p}_mi_w"], [f"{p}_h0"],
+                 name=f"{p}_h0"),
+            node("Add", [f"{p}_h0", f"{p}_mi_b"], [f"{p}_h"],
+                 name=f"{p}_h"),
+        ]
+        _gelu_nodes(f"{p}_g", f"{p}_h", f"{p}_ga", nodes)
+        nodes += [
+            node("MatMul", [f"{p}_ga", f"{p}_mo_w"], [f"{p}_o0"],
+                 name=f"{p}_o0"),
+            node("Add", [f"{p}_o0", f"{p}_mo_b"], [f"{p}_o"],
+                 name=f"{p}_o"),
+            node("Add", [f"{p}_res1", f"{p}_o"], [blk], name=blk),
+        ]
+        prev = blk
+    zp = variables["z"]["params"]
+    _ln_nodes("zln", prev, "z_n", nodes, inits,
+              _np(zp, "ln_f", "scale"), _np(zp, "ln_f", "bias"))
+    head_k = _np(zp, "head", "kernel")
+    inits += [
+        tensor_proto("head_w", head_k),
+        tensor_proto("head_b", _np(zp, "head", "bias")),
+    ]
+    nodes += [
+        node("MatMul", ["z_n", "head_w"], ["z0"], name="z0"),
+        node("Add", ["z0", "head_b"], ["z"], name="z"),
+    ]
+    vocab = head_k.shape[1]
+    return model_proto(
+        nodes, inits,
+        [value_info("x", (batch, seq), elem_type=6)],  # int32 ids
+        [value_info("z", (batch, seq, vocab))],
+    )
+
+
 def export_onnx(graph, variables, sample_shape) -> bytes:
     """Serialize a trained NamedGraph to ONNX bytes.
 
@@ -261,9 +492,14 @@ def export_onnx(graph, variables, sample_shape) -> bytes:
         )
     if name == "bilstm_tagger":
         return _export_bilstm_tagger(variables, tuple(sample_shape))
+    if name == "transformer_lm":
+        return _export_transformer_lm(
+            graph, variables, tuple(sample_shape)
+        )
     raise FriendlyError(
         f"no ONNX exporter for model family '{name}'; supported: linear, "
-        "mlp, bilstm_tagger (conv families persist via the stage format)"
+        "mlp, bilstm_tagger, transformer_lm (conv families persist via "
+        "the stage format)"
     )
 
 

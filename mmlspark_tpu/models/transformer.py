@@ -191,5 +191,6 @@ def transformer_lm(
             "vocab_size": vocab_size,
             "attn_impl": attn_impl,
             "causal": causal,
+            "heads": heads,
         },
     )
