@@ -17,10 +17,14 @@ the importer's decoder; no onnx package in this environment). Exported
 graphs are shape-specialized to the sample shape — consistent with the
 framework's static-shape philosophy (reshape targets bake the dims).
 
-Supported families: ``linear`` / ``mlp`` (Gemm + Relu chains) and
-``bilstm_tagger`` (Gather -> bidirectional LSTM -> per-token projection).
-Convolutional families persist via the native stage format
-(core/serialize); their ONNX export is intentionally out of scope.
+Supported families: ``linear`` / ``mlp`` (Gemm + Relu chains),
+``bilstm_tagger`` (Gather -> bidirectional LSTM -> per-token projection),
+and ``transformer_lm`` (decomposed LayerNorm / multi-head attention /
+tanh-gelu in primitive ops; block outputs keep the flax layer names so
+named-node cuts survive the round trip, and the causal mask is built
+in-graph from O(T) position vectors). Convolutional families persist via
+the native stage format (core/serialize); their ONNX export is
+intentionally out of scope.
 """
 
 from __future__ import annotations

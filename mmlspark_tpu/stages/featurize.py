@@ -137,11 +137,16 @@ class AssembleFeatures(Estimator):
             kind = _column_kind(dataset, name)
             spec: dict[str, Any] = {"name": name, "kind": kind}
             if kind == _TEXT:
-                # count-based slot selection: union of non-zero hash slots
+                # count-based slot selection: union of non-zero hash slots,
+                # tokenizing each DISTINCT value once (census-like string
+                # columns have tiny vocabularies; the per-row loop was the
+                # fit-path hot spot)
                 used: set[int] = set()
+                seen: set[Any] = set()
                 for v in dataset[name]:
-                    if v is None:
+                    if v is None or v in seen:
                         continue
+                    seen.add(v)
                     for t in _tokenize(v):
                         used.add(_hash_token(t, self.number_of_features))
                 spec["slots"] = sorted(used)
@@ -195,14 +200,34 @@ class AssembleFeaturesModel(Model):
             slots = spec["slots"]
             pos = {s: j for j, s in enumerate(slots)}
             out = np.zeros((len(arr), len(slots)), dtype=np.float64)
+            # tokenize+hash once per DISTINCT value; each cache entry is the
+            # (column indices, counts) sparse row it expands to. The cache
+            # is capped so a mostly-distinct free-text column degrades to
+            # the uncached per-row cost instead of doubling memory.
+            cache: dict[Any, tuple[np.ndarray, np.ndarray]] = {}
+            cache_cap = 4096
             for i, v in enumerate(arr):
                 if v is None:
                     out[i] = np.nan
                     continue
-                for t in _tokenize(v):
-                    j = pos.get(_hash_token(t, self.number_of_features))
-                    if j is not None:
-                        out[i, j] += 1.0
+                hit = cache.get(v)
+                if hit is None:
+                    cols = [
+                        j
+                        for t in _tokenize(v)
+                        if (j := pos.get(
+                            _hash_token(t, self.number_of_features)
+                        )) is not None
+                    ]
+                    cj, cc = (
+                        np.unique(cols, return_counts=True)
+                        if cols
+                        else (np.empty(0, np.int64), np.empty(0, np.int64))
+                    )
+                    hit = (cj, cc.astype(np.float64))
+                    if len(cache) < cache_cap:
+                        cache[v] = hit
+                out[i, hit[0]] = hit[1]
             return out
         if kind == _DATETIME:
             return self._maybe_standardize(_datetime_features(arr), spec)
