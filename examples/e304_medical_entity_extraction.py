@@ -52,7 +52,7 @@ def main():
     ids, tags = make_sentences(512, seed=0)
     trainer = SPMDTrainer(
         graph,
-        TrainConfig(epochs=3, batch_size=64, learning_rate=1e-2,
+        TrainConfig(epochs=12, batch_size=64, learning_rate=1e-2,
                     log_every=10),
     )
     variables = trainer.train(ids, tags)
@@ -79,6 +79,7 @@ def main():
         (pred[entity_mask] == test_tags[entity_mask]).mean()
     )
     assert acc > 0.9, f"token accuracy {acc} too low"
+    assert entity_recall > 0.9, f"entity recall {entity_recall} too low"
     extracted = [TAGS[t] for t in pred[0] if t > 0]
     print(f"OK {{'token_accuracy': {acc:.3f}, "
           f"'entity_recall': {entity_recall:.3f}, "

@@ -143,10 +143,14 @@ class AssembleFeatures(Estimator):
                 # fit-path hot spot)
                 used: set[int] = set()
                 seen: set[Any] = set()
+                seen_cap = 4096  # same degrade as the transform cache:
+                # past the cap, mostly-distinct text re-tokenizes instead
+                # of growing the set unboundedly
                 for v in dataset[name]:
                     if v is None or v in seen:
                         continue
-                    seen.add(v)
+                    if len(seen) < seen_cap:
+                        seen.add(v)
                     for t in _tokenize(v):
                         used.add(_hash_token(t, self.number_of_features))
                 spec["slots"] = sorted(used)
