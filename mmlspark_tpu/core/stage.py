@@ -104,6 +104,19 @@ class Transformer(PipelineStage):
     def _transform(self, dataset: Dataset) -> Dataset:
         raise NotImplementedError
 
+    def transform_stream(self, chunks):
+        """Chunkwise streaming transform — the structured-streaming leg of
+        the reference (streamImages -> per-row stages -> CNTKModel, all
+        row-wise; BinaryFileFormat.scala:118 implements the streaming
+        source). Applies this transformer to each Dataset chunk from an
+        iterator (e.g. ``data.readers.stream_images``) and yields the
+        results. Row-wise stages (image ops, feature hashing, DNN
+        inference, prep) are exact under chunking; aggregating stages
+        (e.g. SummarizeData) see one chunk at a time — the same
+        restriction Spark places on streaming aggregations."""
+        for chunk in chunks:
+            yield self.transform(chunk)
+
     def __call__(self, dataset: Dataset) -> Dataset:
         return self.transform(dataset)
 

@@ -27,6 +27,11 @@ import numpy as np
 from mmlspark_tpu.utils.text import hash_token as _hash_token
 from mmlspark_tpu.utils.text import tokenize as _shared_tokenize
 
+#: distinct-value memoization bound shared by the fit-path dedup set and
+#: the transform-path row cache — past it, mostly-distinct free text
+#: degrades to the uncached per-row cost instead of growing memory
+_TEXT_CACHE_CAP = 4096
+
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.params import Param, positive
 from mmlspark_tpu.core.schema import ImageRow
@@ -143,13 +148,10 @@ class AssembleFeatures(Estimator):
                 # fit-path hot spot)
                 used: set[int] = set()
                 seen: set[Any] = set()
-                seen_cap = 4096  # same degrade as the transform cache:
-                # past the cap, mostly-distinct text re-tokenizes instead
-                # of growing the set unboundedly
                 for v in dataset[name]:
                     if v is None or v in seen:
                         continue
-                    if len(seen) < seen_cap:
+                    if len(seen) < _TEXT_CACHE_CAP:
                         seen.add(v)
                     for t in _tokenize(v):
                         used.add(_hash_token(t, self.number_of_features))
@@ -205,11 +207,8 @@ class AssembleFeaturesModel(Model):
             pos = {s: j for j, s in enumerate(slots)}
             out = np.zeros((len(arr), len(slots)), dtype=np.float64)
             # tokenize+hash once per DISTINCT value; each cache entry is the
-            # (column indices, counts) sparse row it expands to. The cache
-            # is capped so a mostly-distinct free-text column degrades to
-            # the uncached per-row cost instead of doubling memory.
+            # (column indices, counts) sparse row it expands to
             cache: dict[Any, tuple[np.ndarray, np.ndarray]] = {}
-            cache_cap = 4096
             for i, v in enumerate(arr):
                 if v is None:
                     out[i] = np.nan
@@ -229,7 +228,7 @@ class AssembleFeaturesModel(Model):
                         else (np.empty(0, np.int64), np.empty(0, np.int64))
                     )
                     hit = (cj, cc.astype(np.float64))
-                    if len(cache) < cache_cap:
+                    if len(cache) < _TEXT_CACHE_CAP:
                         cache[v] = hit
                 out[i, hit[0]] = hit[1]
             return out

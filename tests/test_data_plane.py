@@ -198,3 +198,26 @@ def test_to_device_sharded():
     assert out["x"].shape == (16, 1)
     assert len(out["x"].sharding.device_set) == 8
     np.testing.assert_array_equal(np.asarray(out["x"]), batch["x"])
+
+
+def test_transform_stream_matches_batch(image_dir):
+    """Streaming pipeline (reference structured-streaming leg): chunked
+    stream -> fitted per-row pipeline == one batch transform over the
+    concatenated input."""
+    from mmlspark_tpu.core.stage import Pipeline
+    from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+    pipe = Pipeline([
+        ImageTransformer(output_col="scaled").resize(height=4, width=4),
+        UnrollImage(input_col="scaled", output_col="features"),
+    ])
+    batch = read_images(image_dir)
+    fitted = pipe.fit(batch)
+
+    streamed = list(
+        fitted.transform_stream(stream_images(image_dir, chunk_rows=2))
+    )
+    assert len(streamed) == 2  # 3 images in chunks of 2
+    got = np.concatenate([np.asarray(c["features"]) for c in streamed])
+    want = np.asarray(fitted.transform(batch)["features"])
+    np.testing.assert_array_equal(got, want)
