@@ -81,21 +81,20 @@ def _flagship(jax, jnp):
     return graph, variables
 
 
-def bench_inference(jax, jnp, graph, variables) -> dict:
-    """Images/sec/chip + MFU for ResNet-20 CIFAR inference."""
-    batch = 1024 if _full_scale(jax) else 128
-    x_host = np.random.default_rng(0).normal(size=(batch, 32, 32, 3))
-    # feed bfloat16: the model computes in bf16 regardless (MXU-native;
-    # logits stay f32), so an f32 input buffer only adds transfer bytes
-    x = jnp.asarray(x_host, jnp.bfloat16)
 
-    iters = 60 if _full_scale(jax) else 4
+def _chained_throughput(jax, jnp, graph, variables, x, iters, trials=3):
+    """Shared methodology for model-level throughput: shard the batch over
+    every device, jit `iters` forwards chained by a data dependency inside
+    one lax.scan, time best-of-`trials` around a forced host fetch, and
+    derive FLOPs/image from XLA cost analysis of one forward. Returns
+    (images_per_sec_per_chip, flops_per_image_or_None)."""
+    if jax.device_count() > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    # Methodology: iterations chained by a data dependency inside ONE jit
-    # (so no execution can be elided or overlapped away), timed around a
-    # forced host fetch of a scalar — block_until_ready alone is not a
-    # reliable sync point on remote-execution backends (measured above
-    # hardware peak without the fetch).
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        x = jax.device_put(x, NamedSharding(mesh, P("data")))
+        variables = jax.device_put(variables, NamedSharding(mesh, P()))
+
     def chained(v, x):
         def body(carry, _):
             out = graph.apply(v, carry)
@@ -105,40 +104,45 @@ def bench_inference(jax, jnp, graph, variables) -> dict:
         final, _ = jax.lax.scan(body, x, None, length=iters)
         return final.mean()  # scalar: fetch cost is negligible
 
-    # Shard the batch over all devices (data axis) so the per-chip number
-    # stays honest on multi-device hosts; on one chip this is a no-op.
-    if jax.device_count() > 1:
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-        mesh = Mesh(np.array(jax.devices()), ("data",))
-        x = jax.device_put(x, NamedSharding(mesh, P("data")))
-        variables = jax.device_put(variables, NamedSharding(mesh, P()))
-
     fwd = jax.jit(chained)
     np.asarray(fwd(variables, x))  # warmup / compile
+    dt = min(
+        _timed(lambda: np.asarray(fwd(variables, x))) for _ in range(trials)
+    )
+    batch = x.shape[0]
+    per_chip = batch * iters / dt / jax.device_count()
 
-    # best of 3 timed trials: single-trial numbers swing with relay/tunnel
-    # noise, so the *min* elapsed (= max throughput) is the cleanest
-    # estimate of device capability
-    dt = min(_timed(lambda: np.asarray(fwd(variables, x))) for _ in range(3))
-
-    images_per_sec = batch * iters / dt
-    per_chip = images_per_sec / jax.device_count()
-
-    # FLOPs/image from XLA cost analysis of ONE forward pass (the chained
-    # program can't be used: cost_analysis counts a lax.scan body once, not
-    # times the trip count), falling back to the analytic ResNet-20 estimate
+    # cost_analysis on the chained program would count the scan body once,
+    # not times the trip count — analyze ONE forward instead. Under GSPMD
+    # sharding the report is PER DEVICE (measured: exactly total/n_dev on
+    # the 8-device mesh), so scale back to whole-model FLOPs.
     flops_per_image = None
     try:
-        one_fwd = jax.jit(graph.apply)
-        cost = one_fwd.lower(variables, x).compile().cost_analysis()
+        cost = jax.jit(graph.apply).lower(
+            variables, x
+        ).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
+        flops = float(cost.get("flops", 0.0)) * jax.device_count()
         if flops > 0:
             flops_per_image = flops / batch
     except Exception:
         pass
+    return per_chip, flops_per_image
+
+
+def bench_inference(jax, jnp, graph, variables) -> dict:
+    """Images/sec/chip + MFU for ResNet-20 CIFAR inference."""
+    batch = 1024 if _full_scale(jax) else 128
+    x_host = np.random.default_rng(0).normal(size=(batch, 32, 32, 3))
+    # feed bfloat16: the model computes in bf16 regardless (MXU-native;
+    # logits stay f32), so an f32 input buffer only adds transfer bytes
+    x = jnp.asarray(x_host, jnp.bfloat16)
+    iters = 60 if _full_scale(jax) else 4
+
+    per_chip, flops_per_image = _chained_throughput(
+        jax, jnp, graph, variables, x, iters
+    )
     flops_source = "xla_cost_analysis"
     if not flops_per_image:
         flops_per_image, flops_source = _RESNET20_FLOPS_PER_IMAGE, "analytic"
@@ -189,6 +193,44 @@ def bench_stage_inference(jax, graph, variables) -> dict:
     }
 
 
+def bench_resnet50(jax, jnp) -> dict:
+    """ResNet-50 at 224x224 — the reference zoo's headline featurizer
+    (DefaultModelRepo 'ResNet50', notebooks 303/305). Bottleneck convs
+    fill the MXU far better than ResNet-20's 16-64 channels, so this is
+    the high-arithmetic-intensity MFU figure. Same sharded best-of-3
+    methodology as the flagship metric (shared helper). Guarded by the
+    caller: any failure is reported as a field, never a lost bench."""
+    from mmlspark_tpu.models import build_model
+
+    full = _full_scale(jax)
+    size = 224 if full else 32
+    batch = 256 if full else 4 * max(1, jax.device_count())
+    iters = 30 if full else 2
+    graph = build_model("resnet50", input_size=size)
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3), jnp.float32)
+    )
+    x = jnp.asarray(
+        np.random.default_rng(2).normal(size=(batch, size, size, 3)),
+        jnp.bfloat16,
+    )
+    per_chip, flops_per_image = _chained_throughput(
+        jax, jnp, graph, variables, x, iters
+    )
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    mfu = (
+        per_chip * flops_per_image / peak
+        if peak and flops_per_image
+        else None
+    )
+    return {
+        "resnet50_images_per_sec_per_chip": round(per_chip, 1),
+        "resnet50_mfu": round(mfu, 4) if mfu is not None else None,
+        "resnet50_input": size,
+        "resnet50_batch": batch,
+    }
+
+
 def bench_train_classifier(jax) -> dict:
     """Seconds per TrainClassifier epoch, Adult-Census-shaped (32561 rows —
     the real Adult train-split size, full 14-feature schema)."""
@@ -236,6 +278,10 @@ def run() -> dict:
     graph, variables = _flagship(jax, jnp)
     inf = bench_inference(jax, jnp, graph, variables)
     stage = bench_stage_inference(jax, graph, variables)
+    try:
+        r50 = bench_resnet50(jax, jnp)
+    except Exception as e:  # noqa: BLE001 — secondary metric must not
+        r50 = {"resnet50_error": f"{type(e).__name__}: {e}"}  # kill bench
     train = bench_train_classifier(jax)
     return {
         "metric": "cifar10_resnet20_inference_images_per_sec_per_chip",
@@ -246,6 +292,7 @@ def run() -> dict:
         "backend": jax.default_backend(),
         **inf,
         **stage,
+        **r50,
         **train,
     }
 

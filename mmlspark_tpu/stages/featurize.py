@@ -24,6 +24,11 @@ from typing import Any
 
 import numpy as np
 
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.params import Param, positive
+from mmlspark_tpu.core.schema import ImageRow
+from mmlspark_tpu.core.stage import Estimator, Model
+from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.utils.text import hash_token as _hash_token
 from mmlspark_tpu.utils.text import tokenize as _shared_tokenize
 
@@ -31,12 +36,6 @@ from mmlspark_tpu.utils.text import tokenize as _shared_tokenize
 #: the transform-path row cache — past it, mostly-distinct free text
 #: degrades to the uncached per-row cost instead of growing memory
 _TEXT_CACHE_CAP = 4096
-
-from mmlspark_tpu.core.exceptions import FriendlyError
-from mmlspark_tpu.core.params import Param, positive
-from mmlspark_tpu.core.schema import ImageRow
-from mmlspark_tpu.core.stage import Estimator, Model
-from mmlspark_tpu.data.dataset import Dataset
 
 DEFAULT_NUM_FEATURES = 1 << 18  # Featurize.scala:13
 TREE_NN_NUM_FEATURES = 1 << 12  # Featurize.scala:19
