@@ -1,0 +1,118 @@
+"""Learner-benchmark matrix shared by the fixture generator and its test.
+
+Mirrors the reference's benchmark-verification idea: a fixed set of
+datasets x the full built-in learner list, each trained and scored with
+deterministic seeds, producing one (accuracy, AUC) row per combination
+(VerifyTrainClassifier.scala:41-42,148-240 with benchmarkMetrics.csv).
+One definition here keeps the generator (tools/make_benchmark_metrics.py)
+and the regression test (tests/test_benchmark_metrics.py) on exactly the
+same matrix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from mmlspark_tpu.data.dataset import Dataset
+from mmlspark_tpu.testing.datagen import make_census
+
+#: the reference's supported-learner sweep (TrainClassifier.scala:45-52);
+#: like the reference's CSV, the learner list varies per dataset —
+#: naive Bayes (non-negative features only, the Spark MLlib restriction)
+#: is benchmarked on the count-like census tables only
+ALL_LEARNERS = (
+    "logistic_regression",
+    "decision_tree",
+    "random_forest",
+    "gbt",
+    "naive_bayes",
+    "mlp",
+)
+NO_NB = tuple(l for l in ALL_LEARNERS if l != "naive_bayes")
+
+
+def _multiclass(n: int, seed: int) -> Dataset:
+    """Three classes derivable from the features (a broken learner cannot
+    hide at chance level) with 10% label noise."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    score = np.stack(
+        [x[:, 0] + x[:, 1], x[:, 2] - x[:, 0], x[:, 3] - x[:, 1]], axis=1
+    )
+    y = score.argmax(axis=1)
+    flip = rng.random(n) < 0.10
+    y = np.where(flip, rng.integers(0, 3, n), y).astype(np.int64)
+    cols = {f"num_{i}": x[:, i] for i in range(4)}
+    cols["cat"] = list(rng.choice(["alpha", "beta", "gamma"], n))
+    cols["label"] = y
+    return Dataset(cols)
+
+
+def _noisy_binary(n: int, seed: int) -> Dataset:
+    """A hard binary task: informative numerics + label noise."""
+    rng = np.random.default_rng(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    noise = rng.normal(size=n)
+    flip = rng.random(n) < 0.15
+    y = ((x1 + 0.7 * x2 > 0) ^ flip).astype(np.int64)
+    return Dataset({"a": x1, "b": x2, "noise": noise, "label": y})
+
+
+def datasets() -> dict[str, tuple[Dataset, Dataset, str, tuple]]:
+    """name -> (train, test, label_col, learners); all seeded."""
+    return {
+        "census_full": (
+            make_census(1500, seed=7, full_schema=True),
+            make_census(500, seed=8, full_schema=True),
+            "income",
+            ALL_LEARNERS,
+        ),
+        "census_compact": (
+            make_census(1200, seed=9),
+            make_census(400, seed=10),
+            "income",
+            ALL_LEARNERS,
+        ),
+        "noisy_binary": (
+            _noisy_binary(1200, seed=11),
+            _noisy_binary(400, seed=12),
+            "label",
+            NO_NB,
+        ),
+        "multiclass": (
+            _multiclass(900, seed=13),
+            _multiclass(300, seed=14),
+            "label",
+            NO_NB,
+        ),
+    }
+
+
+@dataclass(frozen=True)
+class BenchRow:
+    dataset: str
+    learner: str
+    accuracy: float
+    auc: str  # formatted to 4 decimals, or "" for multiclass
+
+
+def run_matrix() -> list[BenchRow]:
+    from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
+    from mmlspark_tpu.stages.train_classifier import TrainClassifier
+
+    rows: list[BenchRow] = []
+    for ds_name, (train, test, label, learners) in datasets().items():
+        for learner in learners:
+            model = TrainClassifier(
+                label_col=label, model=learner, seed=0, epochs=12,
+                learning_rate=5e-2,
+            ).fit(train)
+            stats = ComputeModelStatistics().transform(model.transform(test))
+            acc = float(stats["accuracy"][0])
+            auc = (
+                f"{float(stats['AUC'][0]):.4f}" if "AUC" in stats else ""
+            )
+            rows.append(BenchRow(ds_name, learner, acc, auc))
+    return rows

@@ -1,0 +1,52 @@
+"""Recorded-metrics benchmark regression — the reference's distinctive
+TrainClassifier QA artifact: every (dataset, learner) combination retrains
+and must reproduce the committed metrics file line-by-line
+(VerifyTrainClassifier.scala:41-42,224-240 with benchmarkMetrics.csv).
+
+Regenerate the fixture after intentional learner changes:
+``python tools/make_benchmark_metrics.py``.
+"""
+
+import csv
+import os
+
+from mmlspark_tpu.testing.benchmark_metrics import run_matrix
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "fixtures", "benchmark_metrics.csv",
+)
+
+#: CPU-mesh reruns are deterministic, but leave float-op slack across
+#: jax/XLA versions (the reference compares 2-decimal equality)
+TOL = 0.015
+
+
+def test_benchmark_metrics_match_recorded():
+    with open(FIXTURE) as f:
+        recorded = {
+            (r["dataset"], r["learner"]): r for r in csv.DictReader(f)
+        }
+    rows = run_matrix()
+    assert {(r.dataset, r.learner) for r in rows} == set(recorded), (
+        "matrix shape changed; regenerate the fixture"
+    )
+    mismatches = []
+    for r in rows:
+        want = recorded[(r.dataset, r.learner)]
+        if abs(r.accuracy - float(want["accuracy"])) > TOL:
+            mismatches.append(
+                f"{r.dataset}/{r.learner}: accuracy {r.accuracy:.4f} "
+                f"!= recorded {want['accuracy']}"
+            )
+        if bool(want["auc"]) != bool(r.auc):
+            mismatches.append(
+                f"{r.dataset}/{r.learner}: AUC presence changed "
+                f"(run {r.auc!r} vs recorded {want['auc']!r})"
+            )
+        elif want["auc"] and abs(float(r.auc) - float(want["auc"])) > TOL:
+            mismatches.append(
+                f"{r.dataset}/{r.learner}: AUC {r.auc} "
+                f"!= recorded {want['auc']}"
+            )
+    assert not mismatches, "\n".join(mismatches)

@@ -1,0 +1,35 @@
+"""Regenerate the recorded learner-benchmark metrics fixture.
+
+The reference commits ``benchmarkMetrics.csv`` next to its TrainClassifier
+suite and asserts every (dataset, learner) retrain reproduces the recorded
+accuracy line-by-line (VerifyTrainClassifier.scala:41-42,224-240). Same
+artifact here: ``tests/fixtures/benchmark_metrics.csv`` holds
+``dataset,learner,accuracy,auc`` rows produced by this script, and
+``tests/test_benchmark_metrics.py`` re-runs the matrix against it.
+
+Run: ``python tools/make_benchmark_metrics.py`` (CPU mesh; seeds fixed).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "tests", "fixtures", "benchmark_metrics.csv")
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from mmlspark_tpu.testing.benchmark_metrics import run_matrix
+
+    rows = run_matrix()
+    with open(OUT, "w") as f:
+        f.write("dataset,learner,accuracy,auc\n")
+        for r in rows:
+            f.write(f"{r.dataset},{r.learner},{r.accuracy:.4f},{r.auc}\n")
+    print(f"wrote {len(rows)} rows -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
