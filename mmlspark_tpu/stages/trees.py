@@ -131,6 +131,15 @@ def _level_histogram(bins, stats, slot, n_nodes: int, max_bins: int):
     return hists[:, :d]
 
 
+def _mask3(feat_mask):
+    """Broadcast a feature mask onto [nodes, d, bins]: (d,) = one subset
+    for every node; (nodes, d) = an independent subset per node (Spark's
+    featureSubsetStrategy draws per split candidate, not per tree)."""
+    if feat_mask.ndim == 1:
+        return feat_mask[None, :, None]
+    return feat_mask[:, :, None]
+
+
 @partial(jax.jit, static_argnames=("max_bins",))
 def _best_split_xgb(
     hist, feat_mask, max_bins: int, lam, min_child, min_gain
@@ -156,7 +165,7 @@ def _best_split_xgb(
     valid = (
         (left[..., 2] >= min_child)
         & (right[..., 2] >= min_child)
-        & feat_mask[None, :, None]
+        & _mask3(feat_mask)
     )
     gain = jnp.where(valid, gain, -jnp.inf)
     flat = gain.reshape(gain.shape[0], -1)
@@ -192,7 +201,7 @@ def _best_split_gini(hist, feat_mask, max_bins: int, min_child, min_gain):
 
     gain = impurity(total) - impurity(left) - impurity(right)
     lcnt, rcnt = jnp.sum(left, axis=-1), jnp.sum(right, axis=-1)
-    valid = (lcnt >= min_child) & (rcnt >= min_child) & feat_mask[None, :, None]
+    valid = (lcnt >= min_child) & (rcnt >= min_child) & _mask3(feat_mask)
     gain = jnp.where(valid, gain, -jnp.inf)
     flat = gain.reshape(gain.shape[0], -1)
     best = jnp.argmax(flat, axis=1)
@@ -250,15 +259,21 @@ def _build_tree(
     for level in range(max_depth):
         base = 1 << level
         hist = _level_histogram(bins, stats, node - base, base, max_bins)
+        # 2-D masks hold one row per heap slot; this level's nodes occupy
+        # slots [base, 2*base)
+        level_mask = (
+            feat_mask if feat_mask.ndim == 1
+            else feat_mask[base : 2 * base]
+        )
         if criterion == "xgb":
             f, t, g = _best_split_xgb(
-                hist, feat_mask, max_bins,
+                hist, level_mask, max_bins,
                 jnp.float32(lam), jnp.float32(min_child),
                 jnp.float32(min_gain),
             )
         else:
             f, t, g = _best_split_gini(
-                hist, feat_mask, max_bins,
+                hist, level_mask, max_bins,
                 jnp.float32(min_child), jnp.float32(min_gain),
             )
         # per-feature split-gain accumulation stays ON DEVICE (a host
@@ -352,20 +367,43 @@ def _prep_xy(stage, dataset, classification: bool):
     return x, y.astype(np.float32), None
 
 
-def _feature_subset_mask(d, strategy, rng):
+#: per-node masks above this many entries fall back to one subset per
+#: DEPTH LEVEL (shared by that level's nodes) so deep trees don't
+#: materialize a [2^depth, d] array
+_MAX_MASK_ENTRIES = 1 << 22
+
+
+def _subset_size(d, strategy):
+    if strategy == "sqrt":
+        return max(1, int(np.sqrt(d)))
+    if strategy == "onethird":
+        return max(1, d // 3)
+    if strategy == "log2":
+        return max(1, int(np.log2(d)))
+    raise ValueError(f"unknown feature_subset strategy {strategy!r}")
+
+
+def _per_node_masks(d, strategy, rng, heap):
+    """One independent feature subset per internal heap slot (rows
+    [1, heap)); row 0 is unused. Matches Spark semantics, where the
+    subset is redrawn for every split candidate. The draw is one
+    vectorized rank-threshold over uniforms; past _MAX_MASK_ENTRIES the
+    shape degrades to one subset per depth level, which _build_tree
+    broadcasts over that level's nodes via its [base, 2*base) slice of a
+    full-heap mask assembled here."""
     if strategy == "all":
         return np.ones(d, bool)
-    if strategy == "sqrt":
-        m = max(1, int(np.sqrt(d)))
-    elif strategy == "onethird":
-        m = max(1, d // 3)
-    elif strategy == "log2":
-        m = max(1, int(np.log2(d)))
-    else:
-        raise ValueError(f"unknown feature_subset strategy {strategy!r}")
-    mask = np.zeros(d, bool)
-    mask[rng.choice(d, size=m, replace=False)] = True
-    return mask
+    m = _subset_size(d, strategy)
+    if heap * d <= _MAX_MASK_ENTRIES:
+        u = rng.random((heap, d))
+        return u.argsort(axis=1).argsort(axis=1) < m
+    depth = max(1, heap.bit_length() - 1)
+    level_masks = rng.random((depth, d)).argsort(axis=1).argsort(axis=1) < m
+    full = np.ones((heap, d), bool)
+    for level in range(depth):
+        base = 1 << level
+        full[base : 2 * base] = level_masks[level]
+    return full
 
 
 def _normalize_importance(imp: np.ndarray) -> np.ndarray:
@@ -499,9 +537,9 @@ class DecisionTreeClassifier(
                 if self.subsample
                 else np.ones(len(y), np.float32)
             )
-            mask = jnp.asarray(
-                _feature_subset_mask(x.shape[1], self.feature_subset, rng)
-            )
+            mask = jnp.asarray(_per_node_masks(
+                x.shape[1], self.feature_subset, rng, 1 << self.max_depth
+            ))
             f, t, leaves, imp = _build_tree(
                 bins,
                 onehot * jnp.asarray(w)[:, None],
@@ -575,9 +613,9 @@ class DecisionTreeRegressor(
                 if self.subsample
                 else np.ones(len(y), np.float32)
             )
-            mask = jnp.asarray(
-                _feature_subset_mask(x.shape[1], self.feature_subset, rng)
-            )
+            mask = jnp.asarray(_per_node_masks(
+                x.shape[1], self.feature_subset, rng, 1 << self.max_depth
+            ))
             # variance-reduction == second-order gain with g=-y, h=1
             # (leaf value -G/(H+lam) is then the within-leaf label mean)
             stats = jnp.stack(

@@ -105,10 +105,12 @@ def run_matrix() -> list[BenchRow]:
     rows: list[BenchRow] = []
     for ds_name, (train, test, label, learners) in datasets().items():
         for learner in learners:
-            model = TrainClassifier(
-                label_col=label, model=learner, seed=0, epochs=12,
-                learning_rate=5e-2,
-            ).fit(train)
+            kwargs = {"label_col": label, "model": learner, "seed": 0}
+            if learner in ("logistic_regression", "mlp"):
+                # NN knobs only — an explicit learning_rate would also
+                # override GBT's Spark-default step_size 0.1
+                kwargs.update(epochs=12, learning_rate=5e-2)
+            model = TrainClassifier(**kwargs).fit(train)
             stats = ComputeModelStatistics().transform(model.transform(test))
             acc = float(stats["accuracy"][0])
             auc = (
