@@ -6,34 +6,12 @@ ComputeModelStatistics + ComputePerInstanceStatistics. Synthetic
 flight-shaped data stands in for the download.
 """
 
-import numpy as np
-
-from mmlspark_tpu.data.dataset import Dataset
 from mmlspark_tpu.stages.eval_metrics import (
     ComputeModelStatistics,
     ComputePerInstanceStatistics,
 )
 from mmlspark_tpu.stages.train_regressor import TrainRegressor
-
-
-def make_flights(n=800, seed=3) -> Dataset:
-    rng = np.random.default_rng(seed)
-    dep_hour = rng.uniform(0, 24, n)
-    distance = rng.uniform(100, 3000, n)
-    carrier = rng.choice(["AA", "UA", "DL", "WN"], n)
-    carrier_delay = {"AA": 5.0, "UA": 8.0, "DL": 2.0, "WN": 10.0}
-    delay = (
-        0.6 * np.maximum(dep_hour - 15, 0) ** 1.5
-        + distance / 500
-        + np.vectorize(carrier_delay.get)(carrier)
-        + rng.normal(0, 3, n)
-    )
-    return Dataset({
-        "dep_hour": dep_hour,
-        "distance": distance,
-        "carrier": list(carrier),
-        "arr_delay": delay,
-    })
+from mmlspark_tpu.testing.datagen import make_flights
 
 
 def main():

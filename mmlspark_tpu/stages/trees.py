@@ -259,12 +259,16 @@ def _build_tree(
     for level in range(max_depth):
         base = 1 << level
         hist = _level_histogram(bins, stats, node - base, base, max_bins)
-        # 2-D masks hold one row per heap slot; this level's nodes occupy
-        # slots [base, 2*base)
-        level_mask = (
-            feat_mask if feat_mask.ndim == 1
-            else feat_mask[base : 2 * base]
-        )
+        # mask shapes: (d,) = one subset for the whole tree; (max_depth,
+        # d) = one per level; (2^max_depth, d) = one per heap slot, this
+        # level's nodes occupying [base, 2*base). max_depth != 2^max_depth
+        # for every max_depth >= 1, so the dispatch is unambiguous.
+        if feat_mask.ndim == 1:
+            level_mask = feat_mask
+        elif feat_mask.shape[0] == max_depth:
+            level_mask = feat_mask[level]
+        else:
+            level_mask = feat_mask[base : 2 * base]
         if criterion == "xgb":
             f, t, g = _best_split_xgb(
                 hist, level_mask, max_bins,
@@ -397,13 +401,11 @@ def _per_node_masks(d, strategy, rng, heap):
     if heap * d <= _MAX_MASK_ENTRIES:
         u = rng.random((heap, d))
         return u.argsort(axis=1).argsort(axis=1) < m
+    # deep-tree fallback: one subset per depth LEVEL — shape (depth, d),
+    # which _build_tree indexes by level, so no [2^depth, d] array ever
+    # materializes
     depth = max(1, heap.bit_length() - 1)
-    level_masks = rng.random((depth, d)).argsort(axis=1).argsort(axis=1) < m
-    full = np.ones((heap, d), bool)
-    for level in range(depth):
-        base = 1 << level
-        full[base : 2 * base] = level_masks[level]
-    return full
+    return rng.random((depth, d)).argsort(axis=1).argsort(axis=1) < m
 
 
 def _normalize_importance(imp: np.ndarray) -> np.ndarray:

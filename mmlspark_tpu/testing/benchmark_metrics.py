@@ -16,7 +16,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from mmlspark_tpu.data.dataset import Dataset
-from mmlspark_tpu.testing.datagen import make_census
+from mmlspark_tpu.testing.datagen import make_census, make_flights
 
 #: the reference's supported-learner sweep (TrainClassifier.scala:45-52);
 #: like the reference's CSV, the learner list varies per dataset —
@@ -117,4 +117,66 @@ def run_matrix() -> list[BenchRow]:
                 f"{float(stats['AUC'][0]):.4f}" if "AUC" in stats else ""
             )
             rows.append(BenchRow(ds_name, learner, acc, auc))
+    return rows
+
+
+#: TrainRegressor's supported-learner sweep (TrainRegressor.scala:21-130)
+REGRESSORS = (
+    "linear_regression",
+    "decision_tree",
+    "random_forest",
+    "gbt",
+    "mlp",
+)
+
+
+def _linear_noise(n: int, seed: int) -> Dataset:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 5))
+    y = x @ np.array([2.0, -1.0, 0.5, 0.0, 0.0]) + rng.normal(0, 0.5, n)
+    cols = {f"x{i}": x[:, i] for i in range(5)}
+    cols["target"] = y
+    return Dataset(cols)
+
+
+@dataclass(frozen=True)
+class RegBenchRow:
+    dataset: str
+    learner: str
+    r2: float
+    rmse: float
+
+
+def regression_datasets() -> dict[str, tuple[Dataset, Dataset, str]]:
+    return {
+        "flights": (
+            make_flights(800, seed=3),
+            make_flights(250, seed=4),
+            "arr_delay",
+        ),
+        "linear_noise": (
+            _linear_noise(800, seed=21),
+            _linear_noise(250, seed=22),
+            "target",
+        ),
+    }
+
+
+def run_regressor_matrix() -> list[RegBenchRow]:
+    from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
+    from mmlspark_tpu.stages.train_regressor import TrainRegressor
+
+    rows: list[RegBenchRow] = []
+    for ds_name, (train, test, label) in regression_datasets().items():
+        for learner in REGRESSORS:
+            kwargs = {"label_col": label, "model": learner, "seed": 0}
+            if learner in ("linear_regression", "mlp"):
+                kwargs.update(epochs=80, learning_rate=5e-2)
+            model = TrainRegressor(**kwargs).fit(train)
+            stats = ComputeModelStatistics().transform(model.transform(test))
+            rows.append(RegBenchRow(
+                ds_name, learner,
+                float(stats["R^2"][0]),
+                float(stats["root_mean_squared_error"][0]),
+            ))
     return rows

@@ -160,3 +160,28 @@ def bar_images(n: int, seed: int):
             img[short_pos : short_pos + 3, long_pos : long_pos + 11] += 140
         imgs.append(np.clip(img, 0, 255).astype(np.uint8))
     return imgs, y
+
+
+def make_flights(n: int = 800, seed: int = 3) -> Dataset:
+    """Flight-delay-shaped regression table (notebook 102's input shape).
+
+    Shared by the e102 example and the recorded regressor-benchmark
+    matrix so the schema/target rule cannot drift between them.
+    """
+    rng = np.random.default_rng(seed)
+    dep_hour = rng.uniform(0, 24, n)
+    distance = rng.uniform(100, 3000, n)
+    carrier = rng.choice(["AA", "UA", "DL", "WN"], n)
+    carrier_delay = {"AA": 5.0, "UA": 8.0, "DL": 2.0, "WN": 10.0}
+    delay = (
+        0.6 * np.maximum(dep_hour - 15, 0) ** 1.5
+        + distance / 500
+        + np.vectorize(carrier_delay.get)(carrier)
+        + rng.normal(0, 3, n)
+    )
+    return Dataset({
+        "dep_hour": dep_hour,
+        "distance": distance,
+        "carrier": list(carrier),
+        "arr_delay": delay,
+    })

@@ -50,3 +50,41 @@ def test_benchmark_metrics_match_recorded():
                 f"!= recorded {want['auc']}"
             )
     assert not mismatches, "\n".join(mismatches)
+
+
+REG_FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "fixtures", "benchmark_metrics_regression.csv",
+)
+
+
+def test_regressor_benchmark_metrics_match_recorded():
+    """TrainRegressor analog of the recorded matrix
+    (VerifyTrainRegressor.scala's learner sweep)."""
+    from mmlspark_tpu.testing.benchmark_metrics import run_regressor_matrix
+
+    with open(REG_FIXTURE) as f:
+        recorded = {
+            (r["dataset"], r["learner"]): r for r in csv.DictReader(f)
+        }
+    rows = run_regressor_matrix()
+    assert {(r.dataset, r.learner) for r in rows} == set(recorded), (
+        "matrix shape changed; regenerate the fixture"
+    )
+    mismatches = []
+    for r in rows:
+        want = recorded[(r.dataset, r.learner)]
+        if abs(r.r2 - float(want["r2"])) > TOL:
+            mismatches.append(
+                f"{r.dataset}/{r.learner}: R^2 {r.r2:.4f} "
+                f"!= recorded {want['r2']}"
+            )
+        # RMSE is target-scale; compare relative to the recorded value
+        if abs(r.rmse - float(want["rmse"])) > TOL * max(
+            1.0, float(want["rmse"])
+        ):
+            mismatches.append(
+                f"{r.dataset}/{r.learner}: RMSE {r.rmse:.4f} "
+                f"!= recorded {want['rmse']}"
+            )
+    assert not mismatches, "\n".join(mismatches)

@@ -17,11 +17,17 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "tests", "fixtures", "benchmark_metrics.csv")
+REG_OUT = os.path.join(
+    REPO, "tests", "fixtures", "benchmark_metrics_regression.csv"
+)
 
 
 def main() -> None:
     sys.path.insert(0, REPO)
-    from mmlspark_tpu.testing.benchmark_metrics import run_matrix
+    from mmlspark_tpu.testing.benchmark_metrics import (
+        run_matrix,
+        run_regressor_matrix,
+    )
 
     rows = run_matrix()
     with open(OUT, "w") as f:
@@ -29,6 +35,13 @@ def main() -> None:
         for r in rows:
             f.write(f"{r.dataset},{r.learner},{r.accuracy:.4f},{r.auc}\n")
     print(f"wrote {len(rows)} rows -> {OUT}")
+
+    reg_rows = run_regressor_matrix()
+    with open(REG_OUT, "w") as f:
+        f.write("dataset,learner,r2,rmse\n")
+        for r in reg_rows:
+            f.write(f"{r.dataset},{r.learner},{r.r2:.4f},{r.rmse:.4f}\n")
+    print(f"wrote {len(reg_rows)} rows -> {REG_OUT}")
 
 
 if __name__ == "__main__":
