@@ -651,6 +651,66 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
         lo = inp(1, a["min"].f if "min" in a else None)
         hi = inp(2, a["max"].f if "max" in a else None)
         return [jnp.clip(inp(0), lo, hi)]
+    if op == "Neg":
+        return [-inp(0)]
+    if op == "Cast":
+        to = a["to"].i
+        if to not in _DTYPES:
+            raise FriendlyError(f"Cast to unsupported dtype code {to}")
+        return [inp(0).astype(_DTYPES[to])]
+    if op == "Where":
+        return [jnp.where(inp(0), inp(1), inp(2))]
+    if op == "ReduceSum":
+        if len(node.inputs) > 1 and node.inputs[1]:  # opset 13: axes input
+            axes = tuple(_static_ints(env, node.inputs[1], consts))
+        else:
+            axes = tuple(a["axes"].ints) if "axes" in a else ()
+        keep = bool(a["keepdims"].i) if "keepdims" in a else True
+        if not axes:
+            # empty axes: noop_with_empty_axes=1 -> identity, else (the
+            # default) reduce over ALL axes — () would be a silent no-op
+            if "noop_with_empty_axes" in a and a["noop_with_empty_axes"].i:
+                return [inp(0)]
+            axes = None
+        return [inp(0).sum(axis=axes, keepdims=keep)]
+    if op == "Split":
+        x = inp(0)
+        axis = a["axis"].i if "axis" in a else 0
+        if len(node.inputs) > 1 and node.inputs[1]:  # opset 13: sizes input
+            sizes = _static_ints(env, node.inputs[1], consts)
+        elif "split" in a:
+            sizes = list(a["split"].ints)
+        else:  # equal parts, one per declared output
+            n_out = len(node.outputs)
+            if x.shape[axis] % n_out:
+                raise FriendlyError(
+                    f"Split: dim {x.shape[axis]} not divisible into "
+                    f"{n_out} equal outputs and no sizes given"
+                )
+            sizes = [x.shape[axis] // n_out] * n_out
+        if sum(sizes) != x.shape[axis]:
+            raise FriendlyError(
+                f"Split sizes {sizes} do not sum to dim {x.shape[axis]}"
+            )
+        bounds = np.cumsum(sizes)[:-1].tolist()
+        return list(jnp.split(x, bounds, axis=axis))
+    if op == "LayerNormalization":  # opset 17 fused form
+        x, scale = inp(0), inp(1)
+        bias = inp(2) if len(node.inputs) > 2 and node.inputs[2] else None
+        axis = a["axis"].i if "axis" in a else -1
+        eps = a["epsilon"].f if "epsilon" in a else 1e-5
+        axes = tuple(range(axis % x.ndim, x.ndim))
+        # stats in float32 (the spec's stash_type default): fp16 inputs
+        # would overflow the squared term around |x| ~ 256
+        xs = x.astype(jnp.float32)
+        mu = xs.mean(axis=axes, keepdims=True)
+        var = ((xs - mu) ** 2).mean(axis=axes, keepdims=True)
+        out = ((xs - mu) / jnp.sqrt(var + eps)).astype(x.dtype) * scale
+        if bias is not None:
+            out = out + bias
+        # Mean/InvStdDev optional outputs are never consumed by the cut
+        # graphs this importer serves; emit the primary output only
+        return [out]
     if op == "Sum":
         out = env[node.inputs[0]]
         for nm in node.inputs[1:]:

@@ -478,3 +478,47 @@ def test_lstm_custom_activations_rejected(rng):
     ))
     with pytest.raises(Exception, match="activations"):
         g.apply(g.init(), jnp.zeros((5, 1, 3), jnp.float32))
+
+
+def test_transformer_support_ops(rng):
+    """Ops external (torch-style) transformer exports lean on: Split,
+    Cast, Neg, Where, ReduceSum, fused LayerNormalization (opset 17)."""
+    x = rng.normal(size=(2, 6)).astype(np.float32)
+    scale = rng.normal(size=(6,)).astype(np.float32)
+    bias = rng.normal(size=(6,)).astype(np.float32)
+    data = model_proto(
+        nodes=[
+            node("LayerNormalization", ["x", "scale", "bias"], ["ln"],
+                 name="ln", attrs=[attr("axis", i=-1)]),
+            node("Split", ["ln"], ["a", "b"], name="split",
+                 attrs=[attr("axis", i=1)]),
+            node("Neg", ["a"], ["na"], name="na"),
+            node("Cast", ["cond_i"], ["cond"], name="cond",
+                 attrs=[attr("to", i=9)]),
+            node("Where", ["cond", "na", "b"], ["w"], name="w"),
+            # to=6: int32 (float64 would silently stay f32 under jax's
+            # default x64-disabled config)
+            node("Cast", ["w"], ["wc"], name="wc", attrs=[attr("to", i=6)]),
+            node("ReduceSum", ["wc"], ["z"], name="z",
+                 attrs=[attr("axes", ints=[1])]),
+        ],
+        initializers=[
+            tensor_proto("scale", scale),
+            tensor_proto("bias", bias),
+            tensor_proto(
+                "cond_i", np.array([[1, 0, 1]], np.int32)
+            ),
+        ],
+        inputs=[value_info("x", (2, 6))],
+        outputs=[value_info("z", (2, 1))],
+    )
+    graph = load_onnx(data)
+    out = np.asarray(graph.apply(graph.init(), jnp.asarray(x)))
+
+    mu = x.mean(-1, keepdims=True)
+    ln = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * scale + bias
+    a, b = ln[:, :3], ln[:, 3:]
+    w = np.where(np.array([[True, False, True]]), -a, b).astype(np.int32)
+    expect = w.sum(axis=1, keepdims=True)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, expect)
