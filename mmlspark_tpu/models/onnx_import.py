@@ -745,7 +745,8 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
         return _onnx_gru(node, env, a)
     raise FriendlyError(
         f"unsupported ONNX op '{op}' (node '{node.name}'); supported ops "
-        "cover the CNN/MLP families — extend _apply_node for more"
+        "cover the CNN/MLP, LSTM/GRU and transformer families — extend "
+        "_apply_node for more"
     )
 
 
