@@ -149,7 +149,11 @@ def _tensor(buf: bytes) -> tuple[str, np.ndarray]:
         ) if fs.get(10) else np.array([], np.float64)
     else:
         raise FriendlyError(f"tensor '{name}': no data fields for dtype {dt}")
-    return name, arr.reshape(dims) if dims else arr
+    if dims:
+        arr = arr.reshape(dims)
+    elif arr.size == 1:
+        arr = arr.reshape(())  # empty dims = ONNX scalar, not a 1-vector
+    return name, arr
 
 
 @dataclasses.dataclass
@@ -441,6 +445,83 @@ def _onnx_gru(node, env, a):
     return [jnp.stack(ys, axis=1), jnp.stack(hts)]
 
 
+def _fold_constants(node: OnnxNode, consts: dict) -> bool:
+    """Propagate shape arithmetic through ``consts`` with numpy so a
+    downstream Reshape/Expand/Slice can treat it as static. Fires only
+    when every input is already a known constant; returns True when the
+    node was folded (its jnp evaluation is then skipped — shape math on
+    0-d scalars need not be traceable)."""
+    a = node.attrs
+    ins = []
+    for nm in node.inputs:
+        if not nm:
+            ins.append(None)
+            continue
+        if nm not in consts:
+            return False
+        arr = np.asarray(consts[nm])
+        # fold SHAPE math only (small integer/bool tensors): folding float
+        # data would bake initializer values in and ignore retrained
+        # ``variables`` for the same names
+        if arr.dtype.kind not in "iub" or arr.size > 1024:
+            return False
+        ins.append(arr)
+    try:
+        if node.op == "Concat":
+            out = np.concatenate(ins, axis=a["axis"].i)
+        elif node.op == "Gather":
+            axis = a["axis"].i if "axis" in a else 0
+            out = np.take(ins[0], ins[1].astype(np.int64), axis=axis)
+        elif node.op == "Squeeze":
+            axes = tuple(int(v) for v in ins[1].ravel()) if len(ins) > 1 \
+                else tuple(a.get("axes", _Attr()).ints)
+            out = np.squeeze(ins[0], axis=axes or None)
+        elif node.op == "Unsqueeze":
+            axes = tuple(int(v) for v in ins[1].ravel()) if len(ins) > 1 \
+                else tuple(a["axes"].ints)
+            out = ins[0]
+            for ax in sorted(axes):
+                out = np.expand_dims(out, ax)
+        elif node.op == "Add":
+            out = ins[0] + ins[1]
+        elif node.op == "Sub":
+            out = ins[0] - ins[1]
+        elif node.op == "Mul":
+            out = ins[0] * ins[1]
+        elif node.op == "Div":
+            # integer Div truncates toward zero in ONNX (floor would fold
+            # -5/2 to -3 where runtimes produce -2)
+            out = np.trunc(ins[0] / ins[1]).astype(ins[0].dtype) \
+                if ins[0].dtype.kind in "iu" else ins[0] / ins[1]
+        elif node.op == "Cast":
+            to = a["to"].i
+            if to not in _DTYPES:
+                return False
+            out = ins[0].astype(_DTYPES[to])
+        elif node.op == "Slice" and len(ins) > 1:
+            idx = [slice(None)] * ins[0].ndim
+            starts = [int(v) for v in ins[1].ravel()]
+            ends = [int(v) for v in ins[2].ravel()]
+            axes = ([int(v) for v in ins[3].ravel()]
+                    if len(ins) > 3 and ins[3] is not None
+                    else list(range(len(starts))))
+            steps = ([int(v) for v in ins[4].ravel()]
+                     if len(ins) > 4 and ins[4] is not None
+                     else [1] * len(starts))
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                idx[ax] = slice(st, en, sp)
+            out = ins[0][tuple(idx)]
+        else:
+            return False
+    except Exception:
+        return False  # stay dynamic; the jnp path handles the node
+    out = np.asarray(out)
+    if out.dtype.kind not in "iub":
+        return False  # int-in/float-out (Cast) must stay on the data path
+    consts[node.outputs[0]] = out
+    return True
+
+
 def _static_ints(env, name, consts) -> list[int]:
     if name in consts:
         return [int(v) for v in np.asarray(consts[name]).ravel()]
@@ -504,17 +585,33 @@ class OnnxGraph:
 
         params = variables["onnx"]["params"]
         stop = self._check_node(output_node)
+        # shape-math folding reads consts: prefer the caller's CONCRETE
+        # small integer params over the serialized initializers (under
+        # jit those params are tracers and the initializer values hold —
+        # integer shape tensors are not retrained in practice)
+        fold_src = dict(self.initializers)
+        for k, v in params.items():
+            dt = getattr(v, "dtype", None)
+            if dt is not None and np.dtype(dt).kind in "iub" \
+                    and np.size(v) <= 1024:
+                try:
+                    fold_src[k] = np.asarray(v)
+                except Exception:
+                    pass  # tracer under jit
         env: dict[str, Any] = {
             k: jnp.asarray(v) for k, v in params.items()
         }
-        # static-shape constants (Reshape/Slice/Squeeze operands) resolve
-        # from the graph's OWN initializers, never the caller's variables:
-        # under jit those are tracers, and shapes must stay compile-time
-        consts: dict[str, np.ndarray] = dict(self.initializers)
+        # static-shape constants (Reshape/Slice/Squeeze operands and the
+        # fold set) must stay compile-time; fold_src above has already
+        # reconciled them with the caller's concrete params
+        consts: dict[str, np.ndarray] = fold_src
         env[self.input_name] = x
         out = None
         for node in self.nodes:
-            vals = _apply_node(node, env, consts)
+            if _fold_constants(node, consts):
+                vals = [jnp.asarray(consts[node.outputs[0]])]
+            else:
+                vals = _apply_node(node, env, consts)
             for oname, v in zip(node.outputs, vals):
                 env[oname] = v
             out = vals[0]
@@ -568,7 +665,12 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
     if op == "Mul":
         return [inp(0) * inp(1)]
     if op == "Div":
-        return [inp(0) / inp(1)]
+        x0, x1 = inp(0), inp(1)
+        if x0.dtype.kind in "iu" and x1.dtype.kind in "iu":
+            from jax import lax
+
+            return [lax.div(x0, x1)]  # C-style truncation, ONNX semantics
+        return [x0 / x1]
     if op == "Relu":
         return [jax.nn.relu(inp(0))]
     if op == "LeakyRelu":
@@ -651,6 +753,41 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
         lo = inp(1, a["min"].f if "min" in a else None)
         hi = inp(2, a["max"].f if "max" in a else None)
         return [jnp.clip(inp(0), lo, hi)]
+    if op == "Shape":
+        # shapes are static under tracing, so Shape folds to a constant —
+        # the anchor of torch's Shape->Gather->Concat->Reshape chains.
+        # opset 15 adds start/end slicing of the shape vector.
+        full = np.array(inp(0).shape, np.int64)
+        start = a["start"].i if "start" in a else 0
+        end = a["end"].i if "end" in a else len(full)
+        shape = full[start:end]
+        consts[node.outputs[0]] = shape
+        return [jnp.asarray(shape)]
+    if op == "Expand":
+        shape = _static_ints(env, node.inputs[1], consts)
+        x = inp(0)
+        return [jnp.broadcast_to(x, np.broadcast_shapes(x.shape, tuple(shape)))]
+    if op == "Range":
+        vals = []
+        for i in range(3):
+            nm = node.inputs[i]
+            if nm not in consts:
+                raise FriendlyError(
+                    f"Range input '{nm}' must be constant — data-dependent "
+                    "shapes can't compile for TPU"
+                )
+            vals.append(np.asarray(consts[nm]).ravel()[0])
+        out = np.arange(vals[0], vals[1], vals[2])  # dtype from operands
+        if out.dtype.kind in "iub":
+            consts[node.outputs[0]] = out
+        return [jnp.asarray(out)]
+    if op == "ConstantOfShape":
+        shape = _static_ints(env, node.inputs[0], consts)
+        fill = a["value"].t if "value" in a and a["value"].t is not None \
+            else np.zeros(1, np.float32)
+        out = np.full(tuple(shape), fill.ravel()[0], fill.dtype)
+        consts[node.outputs[0]] = out
+        return [jnp.asarray(out)]
     if op == "Neg":
         return [-inp(0)]
     if op == "Cast":

@@ -522,3 +522,46 @@ def test_transformer_support_ops(rng):
     expect = w.sum(axis=1, keepdims=True)
     assert out.dtype == np.int32
     np.testing.assert_array_equal(out, expect)
+
+
+def test_shape_chain_constant_folds(rng):
+    """torch's dynamic-reshape idiom: Shape -> Gather -> Unsqueeze ->
+    Concat -> Reshape. Shapes are static under tracing, so the chain
+    folds to constants and the Reshape target resolves."""
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    data = model_proto(
+        nodes=[
+            node("Shape", ["x"], ["sh"], name="sh"),
+            node("Gather", ["sh", "idx0"], ["b"], name="b",
+                 attrs=[attr("axis", i=0)]),
+            node("Unsqueeze", ["b", "ax0"], ["bu"], name="bu"),
+            node("Concat", ["bu", "minus1"], ["tgt"], name="tgt",
+                 attrs=[attr("axis", i=0)]),
+            node("Reshape", ["x", "tgt"], ["flat"], name="flat"),
+            node("Expand", ["one_row", "row_shape"], ["ones2"],
+                 name="ones2"),
+            node("Mul", ["flat", "ones2"], ["z"], name="z"),
+        ],
+        initializers=[
+            tensor_proto("idx0", np.array(0, np.int64)),
+            tensor_proto("ax0", np.array([0], np.int64)),
+            tensor_proto("minus1", np.array([-1], np.int64)),
+            tensor_proto("one_row", np.ones((1, 1), np.float32)),
+            tensor_proto("row_shape", np.array([1, 12], np.int64)),
+        ],
+        inputs=[value_info("x", (2, 3, 4))],
+        outputs=[value_info("z", (2, 12))],
+    )
+    graph = load_onnx(data)
+    out = np.asarray(graph.apply(graph.init(), jnp.asarray(x)))
+    np.testing.assert_allclose(out, x.reshape(2, 12), atol=1e-6)
+
+    # the chain must also survive jit (static shapes, no tracers leak
+    # into the Reshape target)
+    import jax
+
+    jout = np.asarray(
+        jax.jit(lambda v, t: graph.apply(v, t))(graph.init(),
+                                                jnp.asarray(x))
+    )
+    np.testing.assert_allclose(jout, x.reshape(2, 12), atol=1e-6)
