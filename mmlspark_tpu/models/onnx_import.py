@@ -490,14 +490,37 @@ def _fold_constants(node: OnnxNode, consts: dict) -> bool:
             out = ins[0] * ins[1]
         elif node.op == "Div":
             # integer Div truncates toward zero in ONNX (floor would fold
-            # -5/2 to -3 where runtimes produce -2)
-            out = np.trunc(ins[0] / ins[1]).astype(ins[0].dtype) \
-                if ins[0].dtype.kind in "iu" else ins[0] / ins[1]
+            # -5/2 to -3 where runtimes produce -2). Stay dynamic on a
+            # zero divisor (folding would bake in garbage), and use exact
+            # integer ops — a float intermediate loses precision > 2^53
+            if ins[0].dtype.kind in "iu":
+                if not np.all(ins[1]):
+                    return False
+                out = (
+                    np.sign(ins[0]) * np.sign(ins[1])
+                    * (np.abs(ins[0]) // np.abs(ins[1]))
+                ).astype(ins[0].dtype)
+            else:
+                out = ins[0] / ins[1]
+        elif node.op == "Mod":
+            # fmod=1 -> sign of dividend (C fmod); default -> sign of
+            # divisor (Python %). Zero divisor stays dynamic. Floats
+            # never reach this fold (the iub input filter above), so the
+            # runtime path's float rule cannot diverge.
+            if not np.all(ins[1]):
+                return False
+            fmod = bool(a["fmod"].i) if "fmod" in a else False
+            out = np.fmod(ins[0], ins[1]) if fmod else np.mod(ins[0], ins[1])
         elif node.op == "Cast":
             to = a["to"].i
             if to not in _DTYPES:
                 return False
             out = ins[0].astype(_DTYPES[to])
+        elif node.op == "Reshape":
+            shape = [int(v) for v in ins[1].ravel()]
+            if any(v == 0 for v in shape):
+                return False  # 0 = copy-input-dim in ONNX; stay dynamic
+            out = np.reshape(ins[0], shape)
         elif node.op == "Slice" and len(ins) > 1:
             idx = [slice(None)] * ins[0].ndim
             starts = [int(v) for v in ins[1].ravel()]
@@ -558,6 +581,17 @@ class OnnxGraph:
         self.compute_dtype = None
         self.extra: dict = {"format": "onnx"}
 
+    def _consumed_names(self) -> set:
+        """Tensor names THIS graph reads (cut() graphs see only their own
+        consumers, so an extra output whose reader falls past the cut
+        point does not count). Unconsumed optional outputs (exporters may
+        name LayerNormalization's Mean/InvStdDev unconditionally) are
+        simply never bound; op handlers reject only consumed extras."""
+        consumed = {self.output_name}
+        for n in self.nodes:
+            consumed.update(i for i in n.inputs if i)
+        return consumed
+
     # -- NamedGraph protocol -------------------------------------------------
 
     @property
@@ -607,11 +641,12 @@ class OnnxGraph:
         consts: dict[str, np.ndarray] = fold_src
         env[self.input_name] = x
         out = None
+        consumed = self._consumed_names()
         for node in self.nodes:
             if _fold_constants(node, consts):
                 vals = [jnp.asarray(consts[node.outputs[0]])]
             else:
-                vals = _apply_node(node, env, consts)
+                vals = _apply_node(node, env, consts, consumed)
             for oname, v in zip(node.outputs, vals):
                 env[oname] = v
             out = vals[0]
@@ -641,7 +676,8 @@ class OnnxGraph:
         return sum(int(np.asarray(v).size) for v in src.values())
 
 
-def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
+def _apply_node(node: OnnxNode, env: dict, consts: dict,
+                consumed: set | None = None) -> list:
     import jax
     import jax.numpy as jnp
 
@@ -671,6 +707,14 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
 
             return [lax.div(x0, x1)]  # C-style truncation, ONNX semantics
         return [x0 / x1]
+    if op == "Mod":
+        x0, x1 = inp(0), inp(1)
+        fmod = bool(a["fmod"].i) if "fmod" in a else False
+        if fmod or x0.dtype.kind not in "iu":
+            from jax import lax
+
+            return [lax.rem(x0, x1)]  # sign of dividend (C fmod)
+        return [jnp.mod(x0, x1)]  # default int Mod: sign of divisor
     if op == "Relu":
         return [jax.nn.relu(inp(0))]
     if op == "LeakyRelu":
@@ -819,12 +863,16 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
             sizes = list(a["split"].ints)
         else:  # equal parts, one per declared output
             n_out = len(node.outputs)
-            if x.shape[axis] % n_out:
+            # opset-18 num_outputs semantics: ceil-sized chunks, smaller
+            # final chunk when the dim is indivisible
+            chunk = -(-x.shape[axis] // n_out)
+            sizes = [chunk] * (n_out - 1)
+            sizes.append(x.shape[axis] - chunk * (n_out - 1))
+            if sizes[-1] <= 0:
                 raise FriendlyError(
-                    f"Split: dim {x.shape[axis]} not divisible into "
-                    f"{n_out} equal outputs and no sizes given"
+                    f"Split: dim {x.shape[axis]} cannot fill "
+                    f"{n_out} outputs"
                 )
-            sizes = [x.shape[axis] // n_out] * n_out
         if sum(sizes) != x.shape[axis]:
             raise FriendlyError(
                 f"Split sizes {sizes} do not sum to dim {x.shape[axis]}"
@@ -832,6 +880,16 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict) -> list:
         bounds = np.cumsum(sizes)[:-1].tolist()
         return list(jnp.split(x, bounds, axis=axis))
     if op == "LayerNormalization":  # opset 17 fused form
+        # reject only optional outputs this graph actually reads; names
+        # merely declared by the exporter are never bound (zip truncates)
+        extra = [o for o in node.outputs[1:]
+                 if o and (consumed is None or o in consumed)]
+        if extra:
+            raise FriendlyError(
+                f"LayerNormalization node '{node.name}' has consumed "
+                f"optional outputs {extra} (Mean/InvStdDev) — only the "
+                "primary output is supported"
+            )
         x, scale = inp(0), inp(1)
         bias = inp(2) if len(node.inputs) > 2 and node.inputs[2] else None
         axis = a["axis"].i if "axis" in a else -1
@@ -947,7 +1005,7 @@ def load_onnx(src) -> OnnxGraph:
         out_name = _str(_fields(outs[0][1]), 1)
     if not input_name:
         raise FriendlyError("ONNX graph has no non-initializer input")
-    return OnnxGraph(
+    graph = OnnxGraph(
         name=gname,
         nodes=nodes,
         initializers=initializers,
@@ -955,6 +1013,7 @@ def load_onnx(src) -> OnnxGraph:
         output_name=out_name,
         input_shape=input_shape,
     )
+    return graph
 
 
 def _value_info_shape(fs) -> tuple:

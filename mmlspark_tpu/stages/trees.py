@@ -521,7 +521,7 @@ class DecisionTreeClassifier(
         "bootstrap rows per tree (False = use all rows)", False, ptype=bool
     )
     feature_subset = Param(
-        "features considered per tree", "all",
+        "features considered per split candidate", "all",
         domain=("all", "sqrt", "onethird", "log2"),
     )
 
@@ -582,7 +582,7 @@ class RandomForestClassifier(DecisionTreeClassifier):
     num_trees = Param("trees in the forest", 20, ptype=int, validator=positive)
     subsample = Param("bootstrap rows per tree", True, ptype=bool)
     feature_subset = Param(
-        "features considered per tree", "sqrt",
+        "features considered per split candidate", "sqrt",
         domain=("all", "sqrt", "onethird", "log2"),
     )
 
@@ -597,7 +597,7 @@ class DecisionTreeRegressor(
         "bootstrap rows per tree (False = use all rows)", False, ptype=bool
     )
     feature_subset = Param(
-        "features considered per tree", "all",
+        "features considered per split candidate", "all",
         domain=("all", "sqrt", "onethird", "log2"),
     )
     lambda_ = Param("L2 regularization on leaf values", 0.0, ptype=float)
@@ -656,7 +656,7 @@ class RandomForestRegressor(DecisionTreeRegressor):
     num_trees = Param("trees in the forest", 20, ptype=int, validator=positive)
     subsample = Param("bootstrap rows per tree", True, ptype=bool)
     feature_subset = Param(
-        "features considered per tree", "onethird",
+        "features considered per split candidate", "onethird",
         domain=("all", "sqrt", "onethird", "log2"),
     )
 
