@@ -32,6 +32,9 @@ from mmlspark_tpu.parallel.context_parallel import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from mmlspark_tpu.parallel.sequence_rnn import (  # noqa: F401
+    bilstm_seq_parallel_apply,
+)
 from mmlspark_tpu.parallel.sharding import (  # noqa: F401
     TRANSFORMER_TP_RULES,
     build_param_shardings,

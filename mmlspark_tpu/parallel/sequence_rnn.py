@@ -1,0 +1,159 @@
+"""Sequence-dim sharding for recurrent models (BiLSTM long-context).
+
+The reference's only sequence model is an opaque downloaded CNTK BiLSTM
+run through CNTKModel with notebook-side pad-to-max batching (notebook
+304 - Medical Entity Extraction; SURVEY.md §5 — the reference has no
+sequence parallelism of any kind). Here long sequences shard over a mesh
+axis: each device holds T/S tokens of activations, so the memory
+high-water mark scales down with the axis size — the long-context story
+for recurrent nets, complementing ring/Ulysses attention for
+transformers (context_parallel.py).
+
+A recurrence is sequential in time, so sharding time cannot shard the
+*latency*: the design is a CHUNKED RECURRENCE CHAIN under ``shard_map``.
+Every device holds one contiguous time chunk; the chain runs S rounds,
+each round every device scans its local chunk and hands its final
+(c, h) state to the next device via ``lax.ppermute``; device k's round-k
+scan starts from the true upstream state, and a ``where`` keeps exactly
+that round's outputs. Total compute per device = S * (T/S) = T steps
+(same FLOPs as replicating the whole sequence), but activations stay
+O(T/S) per device — compute is the price, memory is the win, and the
+tiny per-round boundary state (2*B*H floats) rides the ICI.
+
+The cell math is NOT reimplemented: each step calls the flax cell's own
+``apply`` on the variables produced by ``build_model("bilstm_tagger")``,
+so seq-parallel output is bit-compatible with the dense
+``graph.apply`` path up to reduction order.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["bilstm_seq_parallel_apply"]
+
+
+def _chunk_scan(cell, params, carry, xs, reverse: bool):
+    """Scan one local time chunk with the flax cell; returns the final
+    carry and per-token hidden states. ``xs``: (B, Tc, E)."""
+
+    def step(c, x_t):
+        c2, h = cell.apply({"params": params}, c, x_t)
+        return c2, h
+
+    xs_t = jnp.swapaxes(xs, 0, 1)  # (Tc, B, E) — scan over time
+    final, hs = lax.scan(step, carry, xs_t, reverse=reverse)
+    return final, jnp.swapaxes(hs, 0, 1)  # (B, Tc, H)
+
+
+def _chain(cell, params, x_local, hidden: int, axis: str, reverse: bool,
+           vary_axes: tuple = ()):
+    """Chunked recurrence chain over mesh axis ``axis`` (see module
+    docstring). Runs inside shard_map; the round count is the static
+    axis size, so the python loop unrolls at trace time."""
+    n = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    b, tc, _ = x_local.shape
+    # mark the zeros varying over every mesh axis for shard_map's
+    # manual-axes typing: the chain's carries and outputs differ per
+    # device (the scanned x_local varies over all of them)
+    zero = lax.pcast(
+        jnp.zeros((b, hidden), x_local.dtype), vary_axes, to="varying"
+    )
+    # flax LSTM carry is (c, h)
+    carry = (zero, zero)
+    ys = lax.pcast(
+        jnp.zeros((b, tc, hidden), x_local.dtype), vary_axes, to="varying"
+    )
+    # state flows downstream in time: to higher ranks forward, lower
+    # ranks backward. No wraparound — rank 0 (resp. n-1) starts from
+    # zeros, matching the dense scan's initial carry.
+    if reverse:
+        perm = [(i + 1, i) for i in range(n - 1)]
+    else:
+        perm = [(i, i + 1) for i in range(n - 1)]
+    for k in range(n):
+        turn = idx == (n - 1 - k if reverse else k)
+        final, hs = _chunk_scan(cell, params, carry, x_local, reverse)
+        ys = jnp.where(turn, hs, ys)
+        if k == n - 1:
+            break
+        handed = tuple(lax.ppermute(c, axis, perm) for c in final)
+        nxt = idx == (n - 2 - k if reverse else k + 1)
+        carry = tuple(
+            jnp.where(nxt, h, c) for h, c in zip(handed, carry)
+        )
+    return ys
+
+
+def bilstm_seq_parallel_apply(
+    graph: Any,
+    variables: dict,
+    ids: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    data_axis: str | None = "data",
+) -> jax.Array:
+    """Forward pass of a ``bilstm_tagger`` graph with the time dimension
+    sharded over ``mesh[seq_axis]`` (and batch over ``mesh[data_axis]``
+    when present). Differentiable — ppermute transposes cleanly, so the
+    same function serves seq-sharded training.
+
+    ``ids``: (B, T) int32, T divisible by the seq-axis size.
+    Returns (B, T, num_tags) float32 logits, sharded like the input.
+    """
+    import flax.linen as nn
+
+    params = variables["bilstm"]["params"]
+    fwd_p, bwd_p = (
+        params["OptimizedLSTMCell_0"], params["OptimizedLSTMCell_1"],
+    )
+    hidden = fwd_p["hi"]["kernel"].shape[0]
+    cell = nn.OptimizedLSTMCell(hidden)
+    embed = variables["embed"]["params"]["Embed_0"]["embedding"]
+    head = variables["z"]["params"]["Dense_0"]
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if seq_axis not in axis_sizes:
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} has no '{seq_axis}' axis — add one "
+            "(size 1 is fine) or use graph.apply for unsharded inference"
+        )
+    n_seq = axis_sizes[seq_axis]
+    d_ax = data_axis if data_axis in axis_sizes else None
+    if ids.shape[1] % n_seq:
+        raise ValueError(
+            f"sequence length {ids.shape[1]} not divisible by "
+            f"{seq_axis} axis size {n_seq}"
+        )
+
+    io_spec = P(d_ax, seq_axis)
+
+    def local(embed, fwd_p, bwd_p, head, ids_local):
+        x = jnp.take(embed, ids_local, axis=0)  # (b, tc, E) token-local
+        vary = tuple(mesh.axis_names)
+        hf = _chain(cell, fwd_p, x, hidden, seq_axis, reverse=False,
+                    vary_axes=vary)
+        hb = _chain(cell, bwd_p, x, hidden, seq_axis, reverse=True,
+                    vary_axes=vary)
+        h = jnp.concatenate([hf, hb], axis=-1)
+        # TokenLogits math: bf16 compute, f32 params and output
+        hb16 = h.astype(jnp.bfloat16)
+        out = hb16 @ head["kernel"].astype(jnp.bfloat16)
+        out = out + head["bias"].astype(jnp.bfloat16)
+        return out.astype(jnp.float32)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), io_spec),
+        out_specs=P(d_ax, seq_axis),
+    )
+    ids = jax.device_put(ids, NamedSharding(mesh, io_spec))
+    return fn(embed, fwd_p, bwd_p, head, jnp.asarray(ids))
