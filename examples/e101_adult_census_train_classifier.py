@@ -1,29 +1,69 @@
-"""Notebook-101 parity: one-liner TrainClassifier on Adult-Census-like data.
+"""Notebook-101 parity: one-liner TrainClassifier on a REAL table.
 
 Reference flow (notebooks/samples/101 - Adult Census Income Training.ipynb):
 read census table -> TrainClassifier(LogisticRegression, labelCol="income")
--> save model -> score -> ComputeModelStatistics. Same flow here with
-synthetic census-shaped data (no network egress in this environment).
+-> save model -> score -> ComputeModelStatistics. The reference installs
+the real Adult Census CSV at build time (tools/config.sh:62-117); this
+environment has no egress, so the committed REAL table is the complete
+1,309-passenger Titanic manifest (tests/fixtures/titanic.csv, extracted
+from the scikit-learn wheel by tools/make_fixtures.py) — the same shape
+of problem: mixed categorical/numeric columns, missing values, binary
+label. The census-shaped synthetic generator stays as the fallback when
+the fixture is absent.
 """
 
+import os
 import tempfile
+
+import numpy as np
 
 from mmlspark_tpu.core.stage import PipelineStage
 from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
+from mmlspark_tpu.stages.prep import CleanMissingData
 from mmlspark_tpu.stages.train_classifier import TrainClassifier
-from mmlspark_tpu.testing.datagen import make_census
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "titanic.csv"
+)
+
+
+def load_real_or_synthetic():
+    """(train, test, label_col, accuracy_floor)."""
+    if os.path.exists(FIXTURE):
+        from mmlspark_tpu.data.readers import read_csv
+
+        ds = read_csv(FIXTURE)
+        order = np.random.default_rng(0).permutation(len(ds))
+        n_test = len(ds) // 4
+        train = ds.gather(order[n_test:])
+        test = ds.gather(order[:n_test])
+        # age/fare have real gaps; impute numerics like the notebook's
+        # data-prep cell, with TRAIN-only statistics (no test leakage;
+        # missing embarked strings stay their own level)
+        imputer = CleanMissingData(
+            input_cols=["age", "fare"], cleaning_mode="Mean"
+        ).fit(train)
+        return (
+            imputer.transform(train),
+            imputer.transform(test),
+            "survived",
+            0.73,  # real-data bar: standard Titanic tabular accuracy
+        )
+    from mmlspark_tpu.testing.datagen import make_census
+
+    return make_census(seed=7), make_census(n=200, seed=8), "income", 0.75
 
 
 def main():
     from mmlspark_tpu.stages.find_best import FindBestModel
 
-    train, test = make_census(seed=7), make_census(n=200, seed=8)
+    train, test, label, floor = load_real_or_synthetic()
 
     # three learner families, like the notebook's LR/GBT/RF sweep ranked
     # with FindBestModel (notebook 101 cells 4-6)
     candidates = [
         TrainClassifier(
-            label_col="income", model=name, epochs=25, learning_rate=5e-2
+            label_col=label, model=name, epochs=25, learning_rate=5e-2
         ).fit(train)
         for name in ("logistic_regression", "gbt", "random_forest")
     ]
@@ -39,10 +79,11 @@ def main():
     stats = ComputeModelStatistics().transform(scored)
     acc = float(stats["accuracy"][0])
     auc = float(stats["AUC"][0])
-    assert acc > 0.75, f"accuracy {acc} too low"
+    assert acc > floor, f"accuracy {acc} too low (floor {floor})"
     table = best.all_model_metrics
     print(
         f"OK {{'accuracy': {acc:.3f}, 'AUC': {auc:.3f}, "
+        f"'rows': {len(train) + len(test)}, "
         f"'candidates': {len(table)}}}"
     )
 

@@ -1,23 +1,55 @@
-"""Notebook-102 parity: TrainRegressor on flight-delay-shaped data.
+"""Notebook-102 parity: TrainRegressor on a REAL table.
 
 Reference flow (notebooks/samples/102 - Regression Example with Flight
 Delay Dataset.ipynb): read flight table -> TrainRegressor -> score ->
-ComputeModelStatistics + ComputePerInstanceStatistics. Synthetic
-flight-shaped data stands in for the download.
+ComputeModelStatistics + ComputePerInstanceStatistics. The reference
+installs the real On-Time Performance CSV at build time
+(tools/config.sh:62-117); with no egress here, the committed REAL table
+is the UCI Relative CPU Performance set (tests/fixtures/machine_cpu.csv,
+209 machines, extracted from the scikit-learn wheel by
+tools/make_fixtures.py) — the same shape of problem: categorical column
+(vendor ~ carrier) + numerics, continuous target. The flight-shaped
+synthetic generator stays as the fallback when the fixture is absent.
 """
+
+import os
+
+import numpy as np
 
 from mmlspark_tpu.stages.eval_metrics import (
     ComputeModelStatistics,
     ComputePerInstanceStatistics,
 )
 from mmlspark_tpu.stages.train_regressor import TrainRegressor
-from mmlspark_tpu.testing.datagen import make_flights
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "machine_cpu.csv"
+)
+
+
+def load_real_or_synthetic():
+    """(train, test, label_col, r2_floor)."""
+    if os.path.exists(FIXTURE):
+        from mmlspark_tpu.data.readers import read_csv
+
+        ds = read_csv(FIXTURE)
+        order = np.random.default_rng(0).permutation(len(ds))
+        n_test = len(ds) // 4
+        return (
+            ds.gather(order[n_test:]),
+            ds.gather(order[:n_test]),
+            "performance",
+            0.5,
+        )
+    from mmlspark_tpu.testing.datagen import make_flights
+
+    return make_flights(seed=3), make_flights(n=250, seed=4), "arr_delay", 0.5
 
 
 def main():
     from mmlspark_tpu.stages.find_best import FindBestModel
 
-    train, test = make_flights(seed=3), make_flights(n=250, seed=4)
+    train, test, label, floor = load_real_or_synthetic()
     # the notebook trains linear + tree-family regressors (each with its
     # own knobs) and compares; rank with FindBestModel like its
     # evaluation cells
@@ -27,7 +59,7 @@ def main():
         dict(model="random_forest", num_trees=30),
     ]
     candidates = [
-        TrainRegressor(label_col="arr_delay", **cfg).fit(train)
+        TrainRegressor(label_col=label, **cfg).fit(train)
         for cfg in configs
     ]
     best = FindBestModel(models=candidates, evaluation_metric="R^2").fit(
@@ -38,9 +70,10 @@ def main():
     r2 = float(stats["R^2"][0])
     rmse = float(stats["root_mean_squared_error"][0])
     per = ComputePerInstanceStatistics().transform(scored)
-    assert r2 > 0.5, f"R^2 {r2} too low"
+    assert r2 > floor, f"R^2 {r2} too low (floor {floor})"
     assert per["L2_loss"].min() >= 0
     print(f"OK {{'R^2': {r2:.3f}, 'RMSE': {rmse:.2f}, "
+          f"'rows': {len(train) + len(test)}, "
           f"'candidates': {len(best.all_model_metrics)}}}")
 
 

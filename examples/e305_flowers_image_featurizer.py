@@ -1,4 +1,4 @@
-"""Notebook-305 parity: basic vs DNN image featurization on a tiny sample.
+"""Notebook-305 parity: basic vs DNN image featurization, real images.
 
 Reference flow (notebooks/samples/305 - Flowers ImageFeaturizer.ipynb):
 sample a SMALL training set from the flowers data (the notebook keeps 3%),
@@ -7,7 +7,11 @@ UnrollImage raw pixels) and the pretrained DNN cut one layer from the top
 (ModelDownloader -> ImageFeaturizer) — train the same LogisticRegression
 on both feature sets, and compare held-out accuracy. The pretrained
 features win on small data; that comparison is the notebook's headline.
-Same flow here with the committed zoo backbone standing in for ResNet50.
+
+Same flow here on REAL images: the full 10-class sklearn handwritten-digit
+scans, rendered unregistered (random placement), with the zoo's real-data
+backbone ``ResNet20_Digits04`` (pretrained on classes 0-4 only) standing
+in for ResNet50 — its features lift even the classes it never saw.
 """
 
 import os
@@ -26,23 +30,25 @@ from mmlspark_tpu.stages.image import (
     ImageTransformer,
     UnrollImage,
 )
+from mmlspark_tpu.data.sample_data import load_digit_images
 from mmlspark_tpu.stages.prep import SelectColumns
-from mmlspark_tpu.testing.datagen import bar_images
 
 ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "zoo_repo")
 
 
-def make_split(n, seed) -> Dataset:
-    # random-position oriented bars: not linearly separable on raw
-    # pixels, so the pretrained conv features genuinely win (the
-    # notebook's basic-vs-dnn point)
-    imgs, y = bar_images(n, seed=seed)
-    return Dataset({
+def make_splits(n_train, n_test, seed):
+    # real handwritten-digit scans, unregistered placement: raw pixels
+    # stop being linearly separable, so the pretrained conv features
+    # genuinely win (the notebook's basic-vs-dnn point)
+    imgs, y = load_digit_images(max_shift=4, seed=seed)
+    ds = Dataset({
         "image": [
             ImageRow(path=f"img{i}", data=im) for i, im in enumerate(imgs)
         ],
         "labels": y.astype(np.int64),
     })
+    order = np.random.default_rng(seed).permutation(len(y))
+    return ds.gather(order[:n_train]), ds.gather(order[n_train:n_train + n_test])
 
 
 def featurize(featurizer, train, test, name):
@@ -62,10 +68,10 @@ def featurize(featurizer, train, test, name):
 def predict(train_f, test_f) -> float:
     lr = DNNLearner(
         model_name="linear",
-        model_config={"num_outputs": 2},
+        model_config={"num_outputs": 10},
         loss="softmax_xent",
-        epochs=40,
-        learning_rate=5e-2,
+        epochs=150,
+        learning_rate=1e-1,
         features_col="features",
         label_col="labels",
     ).fit(train_f)
@@ -76,8 +82,8 @@ def predict(train_f, test_f) -> float:
 
 def main():
     # tiny train split, larger held-out test — the notebook's 3% sample
-    train = make_split(48, seed=31)
-    test = make_split(200, seed=32)
+    # (120 of 1,797 scans ≈ 7%)
+    train, test = make_splits(120, 500, seed=21)
 
     # basic featurizer: resize + raw-pixel unroll (notebook's it/ur cell)
     basic = Pipeline([
@@ -90,7 +96,7 @@ def main():
     # DNN featurizer: pretrained backbone from the zoo, cut 1 layer
     with tempfile.TemporaryDirectory() as local_repo:
         downloader = ModelDownloader(local_repo, remote=ZOO)
-        schema = downloader.download_by_name("ResNet20_Bars")
+        schema = downloader.download_by_name("ResNet20_Digits04")
         backbone = PipelineStage.load(downloader.local_path(schema))
     dnn = ImageFeaturizer(
         model=backbone, cut_output_layers=1, scale=1.0 / 255.0
@@ -98,8 +104,8 @@ def main():
     dnn_train, dnn_test = featurize(dnn, train, test, "dnn")
     dnn_acc = predict(dnn_train, dnn_test)
 
-    assert dnn_acc > 0.9, f"dnn-featurized accuracy {dnn_acc} too low"
-    assert dnn_acc >= basic_acc + 0.1, (dnn_acc, basic_acc)
+    assert dnn_acc > 0.8, f"dnn-featurized accuracy {dnn_acc} too low"
+    assert dnn_acc >= basic_acc + 0.15, (dnn_acc, basic_acc)
     print(
         f"OK {{'basic_accuracy': {basic_acc:.3f}, "
         f"'dnn_accuracy': {dnn_acc:.3f}, 'train_rows': {len(train)}}}"

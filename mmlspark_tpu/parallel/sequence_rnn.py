@@ -132,6 +132,11 @@ def bilstm_seq_parallel_apply(
             f"sequence length {ids.shape[1]} not divisible by "
             f"{seq_axis} axis size {n_seq}"
         )
+    if d_ax is not None and ids.shape[0] % axis_sizes[d_ax]:
+        raise ValueError(
+            f"batch size {ids.shape[0]} not divisible by "
+            f"{d_ax} axis size {axis_sizes[d_ax]}"
+        )
 
     io_spec = P(d_ax, seq_axis)
 

@@ -30,19 +30,26 @@ def tokenize(value: Any, config: dict | None = None) -> list[str]:
     cfg = config or {}
     if value is None:
         return []
+    if isinstance(value, float) and value != value:  # NaN in a real CSV's
+        return []  # string column (pandas encodes missing cells this way)
     if isinstance(value, (list, tuple)) or (
         hasattr(value, "dtype") and getattr(value, "ndim", 0) == 1
     ):
         toks = [str(t) for t in value]
-    elif cfg.get("use_tokenizer", True):
-        v = value.lower() if cfg.get("to_lowercase", True) else value
-        toks = [
-            t
-            for t in re.split(cfg.get("tokenizer_pattern", DEFAULT_PATTERN), v)
-            if t
-        ]
     else:
-        toks = [value]
+        if not isinstance(value, str):
+            value = str(value)  # mixed object column: featurize, not crash
+        if cfg.get("use_tokenizer", True):
+            v = value.lower() if cfg.get("to_lowercase", True) else value
+            toks = [
+                t
+                for t in re.split(
+                    cfg.get("tokenizer_pattern", DEFAULT_PATTERN), v
+                )
+                if t
+            ]
+        else:
+            toks = [value]
     if cfg.get("remove_stop_words"):
         toks = [t for t in toks if t.lower() not in STOP_WORDS]
     if cfg.get("use_ngram"):

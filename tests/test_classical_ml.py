@@ -269,3 +269,15 @@ def test_sequence_mse_loss_respects_kind():
     labels = jnp.ones((2, 3))
     loss = masked_loss("mse", logits, labels, jnp.array([True, True]))
     assert float(loss) == 0.0  # perfect predictions -> zero MSE
+
+
+def test_tokenize_real_csv_missing_values():
+    """pandas encodes missing cells of a string column as float NaN;
+    tokenize must treat them as empty (found via the real Titanic
+    fixture's embarked column) and stringify other non-str scalars."""
+    from mmlspark_tpu.utils.text import tokenize
+
+    assert tokenize(float("nan")) == []
+    assert tokenize(None) == []
+    assert tokenize(3) == ["3"]
+    assert tokenize("A b") == ["a", "b"]

@@ -174,3 +174,27 @@ def test_committed_payload_scores(tmp_path, name, datagen):
     scored = stage.transform(Dataset({"image": x}))
     acc = float((np.asarray(scored["scores"]).argmax(1) == y).mean())
     assert acc > 0.9, f"{name} committed payload scores {acc} on {datagen}"
+
+
+def test_committed_real_backbone_scores_real_digits(tmp_path):
+    """The real-capability payload (ResNet20_Digits04, trained on the
+    sklearn handwritten-digit scans 0-4 with shift augmentation) must
+    download through the sha256 path, carry its recorded held-out
+    accuracy in the meta, and still score unregistered real digits."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.data.sample_data import load_digit_images
+
+    downloader = ModelDownloader(str(tmp_path), remote=_ZOO_REPO)
+    schema = downloader.download_by_name("ResNet20_Digits04")
+    assert schema.layer_names
+    assert schema.extra.get("test_accuracy", 0) > 0.9
+    assert "real" in schema.dataset or "digits" in schema.dataset
+    stage = PipelineStage.load(downloader.local_path(schema))
+
+    imgs, y = load_digit_images(
+        (0, 1, 2, 3, 4), max_shift=int(schema.extra["max_shift"]), seed=555
+    )
+    x = imgs[:256].astype(np.float32) / 255.0
+    scored = stage.transform(Dataset({"image": x}))
+    acc = float((np.asarray(scored["scores"]).argmax(1) == y[:256]).mean())
+    assert acc > 0.9, f"real backbone scores {acc} on unregistered digits"

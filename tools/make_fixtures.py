@@ -51,7 +51,95 @@ def main() -> None:
     census.to_pandas().to_csv(
         os.path.join(FIXTURES, "census.csv"), index=False
     )
+    extract_real_tables()
     print(f"fixtures written under {FIXTURES}")
+
+
+def _arff_to_rows(path: str) -> tuple[list[str], list[list[str]]]:
+    """Minimal ARFF reader for the bundled samples: attribute names +
+    data rows (comma-separated, optionally quoted, '?' = missing)."""
+    import csv
+    import gzip
+    import io
+
+    names: list[str] = []
+    rows: list[list[str]] = []
+    in_data = False
+    with gzip.open(path, "rt") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("%"):
+                continue
+            low = line.lower()
+            if low.startswith("@attribute"):
+                name = line.split(None, 2)[1].strip("':\"")
+                names.append(name)
+            elif low.startswith("@data"):
+                in_data = True
+            elif in_data:
+                (row,) = csv.reader(io.StringIO(line), quotechar='"')
+                rows.append(["" if v == "?" else v for v in row])
+    return names, rows
+
+
+def extract_real_tables() -> None:
+    """Extract the REAL datasets that ship inside the scikit-learn wheel
+    (tests/data/openml bundled samples — full tables, not truncations)
+    into committed CSVs, the offline analog of the reference's dataset
+    install with sha256 pinning (tools/config.sh:62-117):
+
+    - titanic.csv: the complete 1,309-passenger Titanic manifest
+      (OpenML id 40945) — real mixed-type table with missing values,
+      drives e101's TrainClassifier flow. Leakage columns (boat, body)
+      and free-text ids (name, ticket, cabin, home.dest) are dropped.
+    - machine_cpu.csv: Relative CPU Performance, 209 real machines
+      (OpenML id 561; UCI "Computer Hardware") — vendor categorical +
+      numeric specs, target published relative performance; drives
+      e102's TrainRegressor flow.
+    """
+    import csv
+    import glob
+
+    openml = None
+    for root in sys.path:
+        hits = glob.glob(
+            os.path.join(
+                root, "sklearn", "datasets", "tests", "data", "openml"
+            )
+        )
+        if hits:
+            openml = hits[0]
+            break
+    if openml is None:
+        import sklearn
+
+        openml = os.path.join(
+            os.path.dirname(sklearn.__file__),
+            "datasets", "tests", "data", "openml",
+        )
+
+    names, rows = _arff_to_rows(
+        glob.glob(os.path.join(openml, "id_40945", "data-*.arff.gz"))[0]
+    )
+    keep = ["pclass", "sex", "age", "sibsp", "parch", "fare", "embarked",
+            "survived"]
+    idx = [names.index(k) for k in keep]
+    with open(os.path.join(FIXTURES, "titanic.csv"), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(keep)
+        for row in rows:
+            w.writerow([row[i] for i in idx])
+
+    names, rows = _arff_to_rows(
+        glob.glob(os.path.join(openml, "id_561", "data-*.arff.gz"))[0]
+    )
+    names[-1] = "performance"  # ARFF calls the target 'class'
+    with open(
+        os.path.join(FIXTURES, "machine_cpu.csv"), "w", newline=""
+    ) as f:
+        w = csv.writer(f)
+        w.writerow(names)
+        w.writerows(rows)
 
 
 if __name__ == "__main__":

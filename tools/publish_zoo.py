@@ -66,15 +66,96 @@ def _train_and_publish(name, make_data, epochs, lr) -> None:
           f"{schema.size} bytes, held-out acc {acc:.3f})")
 
 
+def _train_and_publish_digits(name: str) -> None:
+    """The REAL-capability backbone: full-width ResNet-20 trained on the
+    scikit-learn handwritten-digit scans (real images), classes 0-4,
+    shift-augmented so its features survive unregistered inputs — the
+    transfer-learning property the reference zoo's ImageNet CNNs provide
+    (ModelDownloader.scala:109-155). e303 transfers it to digits 5-9."""
+    from mmlspark_tpu.data.sample_data import load_digit_images
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.models.zoo import publish_model
+    from mmlspark_tpu.stages.dnn_model import TPUModel
+    from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
+
+    classes, max_shift, copies = (0, 1, 2, 3, 4), 4, 8
+    # split by UNDERLYING image before augmenting: augmented copies of a
+    # held-out digit must never appear in training
+    _, y = load_digit_images(classes)
+    n = len(y)
+    order = np.random.default_rng(0).permutation(n)
+    tr_idx, te_idx = order[: int(0.85 * n)], order[int(0.85 * n):]
+    xs, ys = [], []
+    for s in range(copies):
+        imgs, _ = load_digit_images(classes, max_shift=max_shift, seed=s)
+        xs.append(imgs[tr_idx])
+        ys.append(y[tr_idx])
+    x = np.concatenate(xs).astype(np.float32) / 255.0
+    yy = np.concatenate(ys).astype(np.int32)
+
+    graph = build_model("resnet20_cifar10", num_classes=len(classes))
+    trainer = SPMDTrainer(
+        graph,
+        TrainConfig(
+            epochs=6, batch_size=128, learning_rate=2e-3,
+            optimizer="adam", lr_schedule="cosine", seed=0, log_every=50,
+        ),
+    )
+    variables = trainer.train(x, yy)
+
+    h_imgs, _ = load_digit_images(classes, max_shift=max_shift, seed=997)
+    hx = h_imgs[te_idx].astype(np.float32) / 255.0
+    pred = np.asarray(graph.apply(variables, hx)).argmax(axis=1)
+    acc = float((pred == y[te_idx]).mean())
+    assert acc > 0.9, f"{name}: held-out accuracy {acc} too low to publish"
+
+    stage = TPUModel.from_graph(
+        graph, variables, "resnet20_cifar10",
+        model_config={"num_classes": len(classes)},
+        input_col="image", output_col="scores",
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        payload = os.path.join(tmp, name.lower())
+        stage.save(payload)
+        schema = publish_model(
+            ZOO,
+            name,
+            payload,
+            input_node="image",
+            layer_names=tuple(graph.layer_names),
+            dataset="sklearn-digits 0-4 (real handwritten scans), "
+                    f"shift-augmented ±{max_shift}px",
+            model_type="image-classifier",
+            extra={
+                "input_scale": "1/255",
+                "classes": list(classes),
+                "max_shift": max_shift,
+                "test_accuracy": round(acc, 4),
+                "test_condition": f"held-out digits, random ±{max_shift}px "
+                                  "placement (unregistered)",
+            },
+        )
+    print(f"published {schema.name} -> {ZOO} (sha256 {schema.hash[:12]}…, "
+          f"{schema.size} bytes, held-out acc {acc:.3f})")
+
+
 def main() -> None:
     sys.path.insert(0, REPO)
     from mmlspark_tpu.testing.datagen import bar_images, blob_images
 
     specs = {
-        "ResNet20_Blobs": (blob_images, 15, 1e-2),
+        "ResNet20_Blobs": lambda: _train_and_publish(
+            "ResNet20_Blobs", blob_images, epochs=15, lr=1e-2
+        ),
         # bars: position-invariant orientation — the conv-vs-raw-pixel
-        # comparison backbone for e305
-        "ResNet20_Bars": (bar_images, 40, 1e-2),
+        # comparison backbone
+        "ResNet20_Bars": lambda: _train_and_publish(
+            "ResNet20_Bars", bar_images, epochs=40, lr=1e-2
+        ),
+        # real data: trained on sklearn digit scans (see function doc)
+        "ResNet20_Digits04": lambda: _train_and_publish_digits(
+            "ResNet20_Digits04"
+        ),
     }
     # republish only the named models (training is not bit-reproducible,
     # so republishing everything churns every committed payload); the
@@ -90,8 +171,7 @@ def main() -> None:
             raise SystemExit(
                 f"unknown model {name!r}; valid names: {', '.join(specs)}"
             )
-        make_data, epochs, lr = specs[name]
-        _train_and_publish(name, make_data, epochs=epochs, lr=lr)
+        specs[name]()
 
 
 if __name__ == "__main__":
