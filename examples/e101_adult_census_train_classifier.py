@@ -15,8 +15,6 @@ the fixture is absent.
 import os
 import tempfile
 
-import numpy as np
-
 from mmlspark_tpu.core.stage import PipelineStage
 from mmlspark_tpu.stages.eval_metrics import ComputeModelStatistics
 from mmlspark_tpu.stages.prep import CleanMissingData
@@ -33,10 +31,7 @@ def load_real_or_synthetic():
         from mmlspark_tpu.data.readers import read_csv
 
         ds = read_csv(FIXTURE)
-        order = np.random.default_rng(0).permutation(len(ds))
-        n_test = len(ds) // 4
-        train = ds.gather(order[n_test:])
-        test = ds.gather(order[:n_test])
+        test, train = ds.random_split(0.25, seed=0)
         # age/fare have real gaps; impute numerics like the notebook's
         # data-prep cell, with TRAIN-only statistics (no test leakage;
         # missing embarked strings stay their own level)

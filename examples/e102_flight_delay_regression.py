@@ -14,7 +14,6 @@ synthetic generator stays as the fallback when the fixture is absent.
 
 import os
 
-import numpy as np
 
 from mmlspark_tpu.stages.eval_metrics import (
     ComputeModelStatistics,
@@ -33,14 +32,8 @@ def load_real_or_synthetic():
         from mmlspark_tpu.data.readers import read_csv
 
         ds = read_csv(FIXTURE)
-        order = np.random.default_rng(0).permutation(len(ds))
-        n_test = len(ds) // 4
-        return (
-            ds.gather(order[n_test:]),
-            ds.gather(order[:n_test]),
-            "performance",
-            0.5,
-        )
+        test, train = ds.random_split(0.25, seed=0)
+        return train, test, "performance", 0.5
     from mmlspark_tpu.testing.datagen import make_flights
 
     return make_flights(seed=3), make_flights(n=250, seed=4), "arr_delay", 0.5

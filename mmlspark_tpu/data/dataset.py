@@ -260,6 +260,16 @@ class Dataset:
         rng = np.random.default_rng(seed)
         return self.gather(rng.permutation(self.num_rows))
 
+    def random_split(
+        self, fraction: float, seed: int = 0
+    ) -> tuple["Dataset", "Dataset"]:
+        """Disjoint (first, second) split with ``fraction`` of the rows
+        in the first part — the train/test split idiom (Spark's
+        ``randomSplit``)."""
+        order = np.random.default_rng(seed).permutation(self.num_rows)
+        cut = int(round(fraction * self.num_rows))
+        return self.gather(order[:cut]), self.gather(order[cut:])
+
     def map_column(
         self,
         name: str,

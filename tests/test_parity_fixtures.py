@@ -126,8 +126,7 @@ def test_titanic_real_fixture_recorded_accuracy():
     assert ds.num_rows == 1309
     assert ds["age"].dtype.kind == "f"  # real gaps -> NaN
     assert np.isnan(ds["age"]).sum() > 200  # 263 missing ages in the data
-    order = np.random.default_rng(0).permutation(len(ds))
-    train, test = ds.gather(order[327:]), ds.gather(order[:327])
+    test, train = ds.random_split(327 / 1309, seed=0)
     imputer = CleanMissingData(
         input_cols=["age", "fare"], cleaning_mode="Mean"
     ).fit(train)  # train-only statistics: no test leakage
@@ -147,8 +146,7 @@ def test_machine_cpu_real_fixture_recorded_r2():
 
     ds = read_csv(os.path.join(FIXTURES, "machine_cpu.csv"))
     assert ds.num_rows == 209
-    order = np.random.default_rng(0).permutation(len(ds))
-    train, test = ds.gather(order[52:]), ds.gather(order[:52])
+    test, train = ds.random_split(52 / 209, seed=0)
     model = TrainRegressor(
         label_col="performance", model="random_forest", num_trees=30,
         seed=0,

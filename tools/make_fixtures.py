@@ -100,23 +100,12 @@ def extract_real_tables() -> None:
     import csv
     import glob
 
-    openml = None
-    for root in sys.path:
-        hits = glob.glob(
-            os.path.join(
-                root, "sklearn", "datasets", "tests", "data", "openml"
-            )
-        )
-        if hits:
-            openml = hits[0]
-            break
-    if openml is None:
-        import sklearn
+    import sklearn
 
-        openml = os.path.join(
-            os.path.dirname(sklearn.__file__),
-            "datasets", "tests", "data", "openml",
-        )
+    openml = os.path.join(
+        os.path.dirname(sklearn.__file__),
+        "datasets", "tests", "data", "openml",
+    )
 
     names, rows = _arff_to_rows(
         glob.glob(os.path.join(openml, "id_40945", "data-*.arff.gz"))[0]
