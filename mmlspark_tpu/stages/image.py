@@ -138,14 +138,18 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
         return compiled
 
     @staticmethod
-    def _apply_ops(compiled: list, img: np.ndarray) -> np.ndarray | None:
+    def _apply_ops(
+        compiled: list, img: np.ndarray, errors: list | None = None
+    ) -> np.ndarray | None:
         try:
             for fn, args in compiled:
                 img = fn(img, *args)
             return img
         except FriendlyError:
             raise
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — per-row containment
+            if errors is not None:
+                errors.append(e)
             return None  # corrupt row -> dropped (ImageTransformer.scala:233)
 
     def _transform(self, dataset: Dataset) -> Dataset:
@@ -153,6 +157,7 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
         compiled = self._compile_ops()  # config errors surface here, once
         col = dataset[self.input_col]
         rows: list[ImageRow | None] = []
+        errors: list[Exception] = []
         for v in col:
             if isinstance(v, ImageRow):
                 img = v.data
@@ -167,8 +172,17 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
             if img is None:
                 rows.append(None)
                 continue
-            out = self._apply_ops(compiled, img)
+            out = self._apply_ops(compiled, img, errors)
             rows.append(ImageRow(path=path, data=out) if out is not None else None)
+        if errors and not any(r is not None for r in rows) and len(col):
+            # EVERY row failing is systemic (dead backend, broken op
+            # config), not corrupt data — silent drop-to-empty here
+            # turns an environment problem into a mystery downstream
+            raise FriendlyError(
+                f"all {len(col)} rows failed in ImageTransformer; "
+                f"first error: {type(errors[0]).__name__}: {errors[0]}",
+                self.uid,
+            ) from errors[0]
         keep = np.array([r is not None for r in rows])
         ds = dataset.filter(keep) if not keep.all() else dataset
         kept_rows = [r for r in rows if r is not None]

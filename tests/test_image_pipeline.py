@@ -183,3 +183,38 @@ def test_typoed_op_param_surfaces_error():
     t.stages = [{"op": "crop", "x": 0, "hight": 5, "width": 5}]  # typo
     with pytest.raises(FriendlyError):
         t.transform(ds)
+
+
+def test_image_transformer_all_rows_failing_raises():
+    """Per-row containment drops corrupt rows, but EVERY row failing is
+    systemic (dead backend, bad op config reaching runtime) and must
+    surface as a FriendlyError naming the cause, not an empty dataset
+    (found via a notebook kernel where jax had no usable backend)."""
+    import pytest
+
+    from mmlspark_tpu.core.exceptions import FriendlyError
+    from mmlspark_tpu.core.schema import ImageRow
+    from mmlspark_tpu.stages.image import ImageTransformer
+
+    ds = Dataset({
+        "image": [ImageRow(path=str(i), data=np.zeros((8, 8, 3), np.uint8))
+                  for i in range(3)],
+    })
+    t = ImageTransformer(input_col="image", output_col="out").resize(4, 4)
+    boom = lambda img, *a: (_ for _ in ()).throw(RuntimeError("backend dead"))
+    t._compile_ops = lambda: [(boom, [])]
+    with pytest.raises(FriendlyError, match="all 3 rows failed"):
+        t.transform(ds)
+
+    # one corrupt row among good ones still degrades to a drop
+    t2 = ImageTransformer(input_col="image", output_col="out").resize(4, 4)
+    real = t2._compile_ops()
+    calls = {"n": 0}
+    def flaky(img, *a):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("corrupt row")
+        return real[0][0](img, *real[0][1])
+    t2._compile_ops = lambda: [(flaky, [])]
+    out = t2.transform(ds)
+    assert out.num_rows == 2

@@ -1,0 +1,57 @@
+"""Notebook tier artifacts (reference notebooks/samples + its headless
+runner, tools/notebook/tester/NotebookTestSuite.py).
+
+The committed .ipynb files are GENERATED from examples/e*.py by
+tools/make_notebooks.py; this test regenerates them into a temp dir and
+compares cell sources so the committed artifacts cannot drift from the
+scripts. Execution of the notebooks is covered by
+``python tools/notebook_tester.py`` (600 s/notebook, PROC_SHARD
+sharding) — run out-of-suite like the reference's notebook tier.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLES = os.path.join(REPO, "notebooks", "samples")
+
+
+def _cells(path):
+    nb = json.load(open(path, encoding="utf-8"))
+    return [
+        ("".join(c["source"]), c["cell_type"]) for c in nb["cells"]
+    ]
+
+
+def test_committed_notebooks_match_scripts(tmp_path):
+    pytest.importorskip("nbformat")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import make_notebooks
+
+    written = make_notebooks.main(str(tmp_path))
+    assert len(written) == 10  # all ten reference sample notebooks
+    for name in written:
+        committed = os.path.join(SAMPLES, name)
+        assert os.path.exists(committed), f"missing committed {name}"
+        assert _cells(committed) == _cells(str(tmp_path / name)), (
+            f"{name} drifted — regenerate with tools/make_notebooks.py"
+        )
+
+
+def test_notebook_tester_discover_shards():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import notebook_tester
+
+    all_names = notebook_tester.discover([])
+    assert len(all_names) == 10
+    os.environ["PROC_SHARD"] = "0/3"
+    try:
+        shard0 = notebook_tester.discover([])
+    finally:
+        del os.environ["PROC_SHARD"]
+    assert shard0 == all_names[::3]
+    only = notebook_tester.discover(["301"])
+    assert len(only) == 1 and only[0].startswith("301")

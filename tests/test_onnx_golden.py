@@ -220,3 +220,39 @@ def test_transformer_encoder_import_matches_torch(tmp_path):
     graph = load_onnx(str(path))
     got = np.asarray(graph.apply(graph.init(), jnp.asarray(x.numpy())))
     np.testing.assert_allclose(got, y.numpy(), atol=1e-4, rtol=1e-4)
+
+
+class _MobileBlock(nn.Module):
+    """MobileNet-style stem: standard conv + depthwise (groups=C) conv +
+    pointwise conv + ReLU6 — exercises grouped Conv and Clip from a real
+    exporter."""
+
+    def __init__(self):
+        super().__init__()
+        self.net = nn.Sequential(
+            nn.Conv2d(3, 16, 3, 2, 1, bias=False), nn.BatchNorm2d(16),
+            nn.ReLU(),
+            nn.Conv2d(16, 16, 3, 1, 1, groups=16, bias=False),
+            nn.BatchNorm2d(16), nn.ReLU(),
+            nn.Conv2d(16, 32, 1, bias=False), nn.BatchNorm2d(32),
+            nn.ReLU6(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(32, 10),
+        )
+
+    def forward(self, x):
+        return self.net(x)
+
+
+def test_depthwise_conv_import_matches_torch(tmp_path):
+    torch.manual_seed(2)
+    model = _MobileBlock().eval()
+    x = torch.randn(2, 3, 32, 32)
+    with torch.no_grad():
+        y = model(x)
+    path = tmp_path / "mobile.onnx"
+    _export_onnx(model, (x,), path)
+    graph = load_onnx(str(path))
+    ops = {n.op for n in graph.nodes}
+    assert "Clip" in ops  # ReLU6
+    got = np.asarray(graph.apply(graph.init(), jnp.asarray(x.numpy())))
+    np.testing.assert_allclose(got, y.numpy(), atol=1e-5, rtol=1e-5)

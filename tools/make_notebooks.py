@@ -1,0 +1,120 @@
+"""Generate the sample-notebook tier from the example scripts.
+
+The reference ships 10 runnable sample notebooks under
+``notebooks/samples/`` (reference ``notebooks/samples/*.ipynb``) and its
+CI executes them headless (tools/notebook/tester/NotebookTestSuite.py).
+Here the examples are maintained once, as ``examples/e*.py`` scripts
+(testable, diffable, shardable), and this tool derives the committed
+notebook artifacts from them: markdown cell from the module docstring,
+one code cell per top-level block, a final ``main()`` cell.
+
+Run: ``python tools/make_notebooks.py`` — writes
+``notebooks/samples/*.ipynb``. Execute them with
+``python tools/notebook_tester.py`` (nbconvert ExecutePreprocessor,
+600 s timeout per notebook, PROC_SHARD sharding — the reference
+harness's exact contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+import nbformat as nbf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+OUT = os.path.join(REPO, "notebooks", "samples")
+
+#: examples -> notebook titles (reference numbering, this repo's data)
+TITLES = {
+    "e101": "101 - Classification on a Real Table (TrainClassifier)",
+    "e102": "102 - Regression on a Real Table (TrainRegressor)",
+    "e103": "103 - Before and After mmlspark_tpu",
+    "e201": "201 - Text Analytics - TextFeaturizer",
+    "e202": "202 - Text Analytics - Word2Vec",
+    "e301": "301 - CIFAR10-style CNN Evaluation (TPUModel)",
+    "e302": "302 - Pipeline Image Transformations",
+    "e303": "303 - Transfer Learning by DNN Featurization",
+    "e304": "304 - Medical Entity Extraction (BiLSTM)",
+    "e305": "305 - ImageFeaturizer: basic vs DNN featurization",
+}
+
+
+def script_to_cells(path: str) -> list:
+    """Split a script into notebook cells at top-level statement groups:
+    docstring -> markdown; imports+constants -> one cell; each def/class
+    -> its own cell; trailing __main__ guard -> a bare main() call."""
+    src = open(path, encoding="utf-8").read()
+    tree = ast.parse(src)
+    lines = src.splitlines()
+
+    cells = []
+    doc = ast.get_docstring(tree)
+    body = list(tree.body)
+    if doc is not None:
+        body.pop(0)
+        title = TITLES.get(os.path.basename(path)[:4], "")
+        cells.append(nbf.v4.new_markdown_cell(f"# {title}\n\n{doc}"))
+    # the scripts resolve repo paths via __file__, which kernels don't
+    # define; the tester runs notebooks with cwd=examples/
+    cells.append(nbf.v4.new_code_cell(
+        "import os\n"
+        f"__file__ = os.path.join(os.getcwd(), {os.path.basename(path)!r})"
+    ))
+
+    def segment(node) -> str:
+        return "\n".join(lines[node.lineno - 1: node.end_lineno])
+
+    # group consecutive non-def statements (imports, constants) into one
+    # cell; each function/class gets its own
+    group: list[str] = []
+    for node in body:
+        is_main_guard = (
+            isinstance(node, ast.If)
+            and isinstance(node.test, ast.Compare)
+            and getattr(node.test.left, "id", "") == "__name__"
+        )
+        if is_main_guard:
+            continue  # replaced by the explicit call cell below
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if group:
+                cells.append(nbf.v4.new_code_cell("\n".join(group)))
+                group = []
+            cells.append(nbf.v4.new_code_cell(segment(node)))
+        else:
+            group.append(segment(node))
+    if group:
+        cells.append(nbf.v4.new_code_cell("\n".join(group)))
+    cells.append(nbf.v4.new_code_cell("main()"))
+    return cells
+
+
+def main(out_dir: str = OUT) -> list:
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for name in sorted(os.listdir(EXAMPLES)):
+        if not (name.startswith("e") and name.endswith(".py")):
+            continue
+        key = name[:4]
+        if key not in TITLES:
+            continue
+        nb = nbf.v4.new_notebook()
+        nb.cells = script_to_cells(os.path.join(EXAMPLES, name))
+        nb.metadata["kernelspec"] = {
+            "name": "python3", "display_name": "Python 3",
+            "language": "python",
+        }
+        out = os.path.join(out_dir, f"{TITLES[key]}.ipynb")
+        with open(out, "w", encoding="utf-8") as f:
+            nbf.write(nb, f)
+        written.append(os.path.basename(out))
+    print(f"wrote {len(written)} notebooks under {out_dir}")
+    for w in written:
+        print(" ", w)
+    return written
+
+
+if __name__ == "__main__":
+    main()
