@@ -14,13 +14,15 @@ null until this repo's own first recorded value exists.
 
 Resilience: TPU backend init through the tunnel can fail transiently OR
 hang outright (BENCH_r01 died raising, BENCH_r02 hung 240 s x 4 with
-nothing recorded). Three defenses, so the driver always gets the most
-informative single JSON line possible:
+nothing recorded, BENCH_r03 wedged through every probe+watchdog). Four
+defenses, so the driver always gets the most informative single JSON
+line possible:
 
-1. a cheap subprocess PROBE (``import jax; jax.devices()``) warms and
-   validates the tunnel before this process commits its own jax to it —
-   a wedged probe is killed and retried with backoff, costing seconds
-   instead of a lost attempt;
+1. a LONG-WINDOW PROBE LOOP: cheap killable subprocess probes
+   (``import jax; jax.devices()``) repeated for up to ~20 min on the
+   first attempt, so a transiently wedged tunnel can recover before any
+   attempt is burned; a wedged probe costs its own timeout, never this
+   process's backend init;
 2. an ESCALATING watchdog on in-process init (240 s -> 480 s -> 900 s)
    re-execs into a fresh process while attempts remain, because jax
    caches a failed backend for the life of the interpreter;
@@ -28,7 +30,15 @@ informative single JSON line possible:
    completes, and the final emission (success, failure, or watchdog)
    merges whatever exists — a hang in attempt 3 can no longer discard
    metrics attempt 1 already measured, and completed groups are skipped
-   on retry instead of re-run.
+   on retry instead of re-run;
+4. a CPU-SMOKE FALLBACK: if the final attempt still cannot reach the
+   TPU, re-exec with ``JAX_PLATFORMS=cpu`` and the relay's env
+   registration neutralized (``PALLAS_AXON_POOL_IPS`` unset — the axon
+   sitecustomize hook otherwise forces the wedged backend into every
+   process) and run all four metric groups at smoke scale. The emitted
+   line then carries ``"backend": "cpu"`` + ``"error_class":
+   "backend_unreachable"`` — proof the bench path executes even when
+   the chip is gone, instead of a line full of nulls.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -47,11 +57,18 @@ import numpy as np
 
 _ATTEMPT_ENV = "MMLTPU_BENCH_ATTEMPT"
 _SCRATCH_ENV = "MMLTPU_BENCH_SCRATCH"
+_CPU_SMOKE_ENV = "MMLTPU_BENCH_CPU_SMOKE"
 _MAX_ATTEMPTS = 3
 #: per-attempt in-process init watchdog; escalates so a slow-but-alive
 #: tunnel gets room on the final try (VERDICT r02 prescription)
 _INIT_TIMEOUT_S = (240.0, 480.0, 900.0)
-_PROBE_TIMEOUT_S = 120.0
+_PROBE_TIMEOUT_S = 60.0
+#: per-attempt probe-loop window: long on attempt 1 so a transiently
+#: wedged tunnel can recover (VERDICT r03 prescription), short later —
+#: by then the tunnel has been dead for >20 min and the CPU-smoke
+#: fallback is the better use of the driver's remaining patience
+_PROBE_WINDOW_S = (1200.0, 300.0, 120.0)
+_PROBE_SLEEP_S = 15.0
 _BACKOFF_S = (5, 20)
 
 _PRIMARY_METRIC = "cifar10_resnet20_inference_images_per_sec_per_chip"
@@ -61,6 +78,7 @@ _GROUPS = {
     "stage": ("stage_images_per_sec_per_chip",),
     "resnet50": ("resnet50_images_per_sec_per_chip", "resnet50_mfu"),
     "train": ("train_epoch_seconds",),
+    "trees": ("gbt_fit_seconds",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -129,8 +147,12 @@ def _scratch_merge(update: dict) -> dict:
     whole. Atomic rename so a watchdog firing mid-write can't truncate."""
     data = {**_scratch_load(), **update}
     path = _scratch_path()
-    tmp = f"{path}.tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
+    # unique tmp per write: the watchdog timer thread can merge while the
+    # main thread is mid-merge; a shared tmp name would interleave writes
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", prefix=".mmltpu_scratch_"
+    )
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
         json.dump(data, f)
     os.replace(tmp, path)
     return data
@@ -335,6 +357,57 @@ def bench_train_classifier(jax) -> dict:
     }
 
 
+def bench_trees(jax) -> dict:
+    """Seconds per TrainClassifier(model='gbt') fit at census scale —
+    the tree family the reference outsources to Spark MLlib
+    (TrainClassifier.scala:45-52). Trees featurize at 2^12 hashed dims,
+    so this times the histogram builder's device path AND the host
+    binning phase (quantile_edges/bin_features) that feeds it; the
+    host share is reported so a host-bound regression is visible."""
+    from mmlspark_tpu.stages import trees
+    from mmlspark_tpu.stages.train_classifier import TrainClassifier
+    from mmlspark_tpu.testing.datagen import make_census
+
+    full = _full_scale(jax)
+    n = 32561 if full else 2048
+    ds = make_census(n, seed=11, full_schema=True)
+
+    host_t = {"s": 0.0}
+    orig_edges, orig_bins = trees.quantile_edges, trees.bin_features
+
+    def timed_wrap(fn):
+        def inner(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            host_t["s"] += time.perf_counter() - t0
+            return out
+
+        return inner
+
+    def fit() -> float:
+        tc = TrainClassifier(
+            label_col="income", model="gbt", seed=0,
+            max_iter=10 if full else 4, max_depth=5,
+        )
+        return _timed(lambda: tc.fit(ds))
+
+    trees.quantile_edges = timed_wrap(orig_edges)
+    trees.bin_features = timed_wrap(orig_bins)
+    try:
+        fit()  # warmup: featurize + level-step compiles
+        host_t["s"] = 0.0
+        dt = fit()
+    finally:
+        trees.quantile_edges, trees.bin_features = orig_edges, orig_bins
+    return {
+        "gbt_fit_seconds": round(dt, 3),
+        "gbt_binning_host_seconds": round(host_t["s"], 3),
+        "gbt_rows": n,
+        "gbt_hashed_dims": 4096,
+        "gbt_trees": 10 if full else 4,
+    }
+
+
 # --------------------------------------------------------------------------
 # envelope
 # --------------------------------------------------------------------------
@@ -362,17 +435,67 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
         return False, f"probe spawn failed: {e}"
 
 
+def _probe_loop(attempt: int) -> tuple[bool, str]:
+    """Keep probing until the tunnel answers or the attempt's window
+    closes. A transiently wedged tunnel (the BENCH_r03 failure mode)
+    gets the whole window to come back; each stuck probe burns only its
+    own subprocess timeout."""
+    window = float(
+        os.environ.get(
+            "MMLTPU_BENCH_PROBE_WINDOW_S",
+            _PROBE_WINDOW_S[min(attempt, _MAX_ATTEMPTS) - 1],
+        )
+    )
+    timeout = float(
+        os.environ.get("MMLTPU_BENCH_PROBE_TIMEOUT_S", _PROBE_TIMEOUT_S)
+    )
+    deadline = time.monotonic() + window
+    n = 0
+    while True:
+        n += 1
+        ok, diag = _probe_backend(timeout)
+        if ok:
+            return True, f"{diag} (probe {n})"
+        if time.monotonic() >= deadline:
+            return False, f"{diag} ({n} probes over {window:.0f}s window)"
+        time.sleep(min(_PROBE_SLEEP_S, max(0.0, deadline - time.monotonic())))
+
+
+def _cpu_smoke_mode() -> bool:
+    return bool(os.environ.get(_CPU_SMOKE_ENV))
+
+
+def _reexec_cpu_smoke(reason: str) -> None:
+    """Final fallback (VERDICT r03): the chip is unreachable, so prove
+    the bench path itself by re-exec'ing onto the CPU backend and running
+    every metric group at smoke scale. ``PALLAS_AXON_POOL_IPS`` must be
+    UNSET, not just overridden: the axon sitecustomize hook keys on it
+    and force-registers the wedged backend over JAX_PLATFORMS."""
+    _scratch_merge({"fallback_reason": reason})
+    env = {
+        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env[_CPU_SMOKE_ENV] = "1"
+    env[_ATTEMPT_ENV] = str(_MAX_ATTEMPTS)
+    os.execve(sys.executable, [sys.executable, __file__], env)
+
+
 def run(attempt: int) -> dict:
     results = _scratch_load()
 
-    probe_ok, probe_diag = _probe_backend(_PROBE_TIMEOUT_S)
-    results = _scratch_merge({"probe": probe_diag})
-    if not probe_ok and attempt < _MAX_ATTEMPTS:
-        # tunnel looks dead/wedged — don't burn this process's one shot
-        # at backend init on it; backoff and re-exec counts the attempt
-        raise RuntimeError(f"backend probe failed: {probe_diag}")
-    # on the final attempt proceed regardless: the probe can be flaky
-    # while real init works, and the 900 s watchdog still bounds a hang
+    if not _cpu_smoke_mode():
+        probe_ok, probe_diag = _probe_loop(attempt)
+        results = _scratch_merge({"probe": probe_diag})
+        if not probe_ok:
+            if attempt < _MAX_ATTEMPTS:
+                # tunnel looks dead/wedged — don't burn this process's
+                # one shot at backend init on it; re-exec counts the
+                # attempt (with a shorter probe window next time)
+                raise RuntimeError(f"backend probe failed: {probe_diag}")
+            _reexec_cpu_smoke(f"backend probe failed: {probe_diag}")
+    # probe succeeded (or CPU smoke): the watchdog still bounds a hang —
+    # the tunnel can wedge between the probe and this process's init
 
     watchdog = _watchdog(
         float(
@@ -394,9 +517,10 @@ def run(attempt: int) -> dict:
         # retry envelope, not be shot mid-backoff with a bogus "hung"
         watchdog.cancel()
 
+    backend = jax.default_backend()
     results = _scratch_merge({
         "devices": jax.device_count(),
-        "backend": jax.default_backend(),
+        "backend": backend,
     })
 
     # each group: skip if a previous attempt already landed it; run under
@@ -416,6 +540,7 @@ def run(attempt: int) -> dict:
         "stage": lambda: bench_stage_inference(jax, *flagship()),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "train": lambda: bench_train_classifier(jax),
+        "trees": lambda: bench_trees(jax),
     }
     errors: dict[str, str] = {}
     metric_wd = _watchdog(
@@ -428,7 +553,13 @@ def run(attempt: int) -> dict:
             if _group_done(results, group):
                 continue
             try:
-                results = _scratch_merge(fn())
+                metrics = fn()
+                # per-group provenance: a fallback attempt can land some
+                # groups on cpu after earlier attempts landed others on
+                # tpu — the line must say which numbers are which
+                gb = {**_scratch_load().get("group_backends", {}),
+                      group: backend}
+                results = _scratch_merge({**metrics, "group_backends": gb})
             except Exception as e:  # noqa: BLE001 — per-group isolation
                 errors[group] = f"{type(e).__name__}: {e}"
     finally:
@@ -446,14 +577,22 @@ def run(attempt: int) -> dict:
     # retry-worthy only if a group failed AND attempts remain — the scratch
     # file ensures the retry runs just the missing groups
     missing = [g for g in _GROUPS if not _group_done(results, g)]
-    if missing and attempt < _MAX_ATTEMPTS:
+    if missing and attempt < _MAX_ATTEMPTS and not _cpu_smoke_mode():
         raise RuntimeError(f"metric groups failed: {missing}: {errors}")
+    if _cpu_smoke_mode():
+        # the CPU numbers prove the bench path executes; the error fields
+        # keep the line honest about WHY it is not a TPU number
+        return _final_line(
+            results, attempt,
+            error=results.get("fallback_reason", "TPU unreachable"),
+        )
     return _final_line(results, attempt)
 
 
 def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
     """Assemble the single output line from whatever the scratch holds."""
     results = dict(results)
+    results.pop("fallback_reason", None)  # folded into ``error`` below
     missing = [g for g in _GROUPS if not _group_done(results, g)]
     line = {
         "metric": _PRIMARY_METRIC,
@@ -463,13 +602,21 @@ def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
     }
     if not results.get("group_errors"):
         results.pop("group_errors", None)
+    probe = str(results.get("probe", ""))
+    if not error:
+        results.pop("probe", None)  # bookkeeping; keep only on failure
     line.update(results)
+    # top-level backend describes the HEADLINE value's provenance; the
+    # emitting process's backend can differ after a fallback re-exec
+    # (per-group provenance stays in group_backends)
+    primary_backend = results.get("group_backends", {}).get("inference")
+    if primary_backend:
+        line["backend"] = primary_backend
     if missing:
         line["missing_metrics"] = missing
     if error:
         line["error"] = error
         # distinguish "chip unreachable" from "code broken" for the judge
-        probe = str(results.get("probe", ""))
         unreachable = (
             "hung" in error
             or "probe failed" in error
@@ -479,9 +626,42 @@ def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
         line["error_class"] = (
             "backend_unreachable" if unreachable else "bench_failure"
         )
+    if _cpu_smoke_mode():
+        # ``error_class`` is NOT forced here: the generic classifier above
+        # already labels tunnel-shaped reasons unreachable, and a genuine
+        # bench-code crash during the smoke run must keep bench_failure.
+        # Scale label is per the PRIMARY metric's provenance — a TPU
+        # number landed by an earlier attempt stays labeled tpu.
+        gb = results.get("group_backends", {})
+        if gb.get("inference") == "tpu":
+            line["scale"] = "partial_tpu_then_cpu_smoke"
+        else:
+            line["scale"] = "cpu_smoke"
+            # the headline field means "per-chip TPU number": a CPU smoke
+            # figure must NOT occupy it (a driver keying on value/exit
+            # code would record it as the first real baseline). The
+            # executed CPU measurement stays in the body, labeled.
+            if line.get("value") is not None:
+                line["images_per_sec_per_chip"] = line["value"]
+                line["value"] = None
     if attempt > 1:
         line["attempts"] = attempt
     return line
+
+
+def _emit(line: dict) -> None:
+    """Terminal emission: print the one line and drop the scratch file."""
+    try:
+        os.unlink(_scratch_path())
+    except OSError:
+        pass
+    print(json.dumps(line), flush=True)
+
+
+def _emit_and_exit(line: dict) -> None:
+    """Exit-code contract: 0 iff the primary metric landed."""
+    _emit(line)
+    sys.exit(0 if line.get("value") is not None else 5)
 
 
 def _watchdog(seconds: float, attempt: int, what: str):
@@ -490,23 +670,25 @@ def _watchdog(seconds: float, attempt: int, what: str):
     no JSON at its own timeout. The timer gives a hang the same treatment
     a raising failure gets: re-exec into a fresh process (new tunnel
     connection) while attempts remain — the scratch file makes the retry
-    skip already-landed metric groups — and on the final attempt emit the
-    line (still carrying every metric any attempt persisted) and exit 7.
-    cancel() it once the guarded phase returns."""
+    skip already-landed metric groups — then the CPU-smoke fallback, and
+    only then emit the line (still carrying every metric any attempt
+    persisted). Exit code follows the primary-metric rule (0 iff present,
+    7 for the metricless hang) so a hang in a late group can't mask a
+    headline value already measured. cancel() it once the guarded phase
+    returns."""
     import threading
 
     def fire():
+        err = f"{what} hung for {seconds:.0f}s (watchdog)"
         if attempt < _MAX_ATTEMPTS:
             env = dict(os.environ, **{_ATTEMPT_ENV: str(attempt + 1)})
             os.execve(sys.executable, [sys.executable, __file__], env)
-        print(
-            json.dumps(_final_line(
-                _scratch_load(), attempt,
-                error=f"{what} hung for {seconds:.0f}s (watchdog)",
-            )),
-            flush=True,
-        )
-        os._exit(7)
+        if not _cpu_smoke_mode():
+            _reexec_cpu_smoke(err)
+        line = _final_line(_scratch_load(), attempt, error=err)
+        _emit(line)
+        # 7 (not 5) distinguishes the metricless HANG for the driver
+        os._exit(0 if line.get("value") is not None else 7)
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -518,9 +700,7 @@ def main() -> None:
     attempt = int(os.environ.get(_ATTEMPT_ENV, "1"))
     _scratch_path()  # claim the shared scratch file before any work
     try:
-        line = run(attempt)
-        print(json.dumps(line))
-        sys.exit(0 if line.get("value") is not None else 5)
+        _emit_and_exit(run(attempt))
     except SystemExit:
         raise
     except Exception as e:  # noqa: BLE001 — last-line diagnostics by design
@@ -531,11 +711,13 @@ def main() -> None:
             # fresh process: jax caches a failed backend for the life of
             # the interpreter, so in-process retry would see the same error
             os.execve(sys.executable, [sys.executable, __file__], env)
-        line = _final_line(
-            _scratch_load(), attempt, error=f"{type(e).__name__}: {e}"
+        if not _cpu_smoke_mode():
+            # a raising (not hanging) final-attempt failure still owes the
+            # driver executed metrics — same fallback as the watchdog path
+            _reexec_cpu_smoke(f"{type(e).__name__}: {e}")
+        _emit_and_exit(
+            _final_line(_scratch_load(), attempt, error=f"{type(e).__name__}: {e}")
         )
-        print(json.dumps(line))
-        sys.exit(0 if line.get("value") is not None else 5)
 
 
 if __name__ == "__main__":
