@@ -571,13 +571,14 @@ class OnnxGraph:
     def __init__(self, name: str, nodes: list[OnnxNode],
                  initializers: dict[str, np.ndarray],
                  input_name: str, output_name: str,
-                 input_shape: tuple = ()):
+                 input_shape: tuple = (), opset: int | None = None):
         self.name = name
         self.nodes = nodes
         self.initializers = initializers
         self.input_name = input_name
         self.output_name = output_name
         self.input_shape = input_shape
+        self.opset = opset  # default-domain ai.onnx version (None: unknown)
         self.compute_dtype = None
         self.extra: dict = {"format": "onnx"}
 
@@ -646,7 +647,7 @@ class OnnxGraph:
             if _fold_constants(node, consts):
                 vals = [jnp.asarray(consts[node.outputs[0]])]
             else:
-                vals = _apply_node(node, env, consts, consumed)
+                vals = _apply_node(node, env, consts, consumed, self.opset)
             for oname, v in zip(node.outputs, vals):
                 env[oname] = v
             out = vals[0]
@@ -667,6 +668,7 @@ class OnnxGraph:
             input_name=self.input_name,
             output_name=kept[-1].outputs[0],
             input_shape=self.input_shape,
+            opset=self.opset,
         )
 
     def param_count(self, variables=None) -> int:
@@ -677,7 +679,8 @@ class OnnxGraph:
 
 
 def _apply_node(node: OnnxNode, env: dict, consts: dict,
-                consumed: set | None = None) -> list:
+                consumed: set | None = None,
+                opset: int | None = None) -> list:
     import jax
     import jax.numpy as jnp
 
@@ -863,6 +866,19 @@ def _apply_node(node: OnnxNode, env: dict, consts: dict,
             sizes = list(a["split"].ints)
         else:  # equal parts, one per declared output
             n_out = len(node.outputs)
+            if (
+                opset is not None
+                and opset < 18
+                and x.shape[axis] % n_out
+            ):
+                # pre-18 opsets require an even split when no sizes are
+                # given (onnxruntime errors); only opset 18's num_outputs
+                # form defines the smaller final chunk
+                raise FriendlyError(
+                    f"Split (opset {opset}): dim {x.shape[axis]} is not "
+                    f"divisible by {n_out} outputs and no 'split' sizes "
+                    "given"
+                )
             # opset-18 num_outputs semantics: ceil-sized chunks, smaller
             # final chunk when the dim is indivisible
             chunk = -(-x.shape[axis] // n_out)
@@ -1005,6 +1021,14 @@ def load_onnx(src) -> OnnxGraph:
         out_name = _str(_fields(outs[0][1]), 1)
     if not input_name:
         raise FriendlyError("ONNX graph has no non-initializer input")
+    # ModelProto.opset_import (field 8): default-domain ai.onnx version
+    # gates version-dependent op semantics (e.g. Split's uneven chunks)
+    opset = None
+    for _, buf in model.get(8, []):
+        fs = _fields(buf)
+        if _str(fs, 1) in ("", "ai.onnx"):
+            v = _int(fs, 2)
+            opset = v if opset is None else max(opset, v)
     graph = OnnxGraph(
         name=gname,
         nodes=nodes,
@@ -1012,6 +1036,7 @@ def load_onnx(src) -> OnnxGraph:
         input_name=input_name,
         output_name=out_name,
         input_shape=input_shape,
+        opset=opset,
     )
     return graph
 

@@ -158,6 +158,7 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
         col = dataset[self.input_col]
         rows: list[ImageRow | None] = []
         errors: list[Exception] = []
+        attempted = 0  # rows that actually reached the op pipeline
         for v in col:
             if isinstance(v, ImageRow):
                 img = v.data
@@ -172,14 +173,19 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
             if img is None:
                 rows.append(None)
                 continue
+            attempted += 1
             out = self._apply_ops(compiled, img, errors)
             rows.append(ImageRow(path=path, data=out) if out is not None else None)
-        if errors and not any(r is not None for r in rows) and len(col):
-            # EVERY row failing is systemic (dead backend, broken op
-            # config), not corrupt data — silent drop-to-empty here
-            # turns an environment problem into a mystery downstream
+        if attempted and len(errors) == attempted:
+            # EVERY row that reached the op pipeline failing is systemic
+            # (dead backend, broken op config), not corrupt data — silent
+            # drop-to-empty here turns an environment problem into a
+            # mystery downstream. Rows dropped at decode time are counted
+            # separately: those degrade to drops as documented.
+            dropped = len(col) - attempted
             raise FriendlyError(
-                f"all {len(col)} rows failed in ImageTransformer; "
+                f"all {attempted} rows that reached the op pipeline failed "
+                f"in ImageTransformer ({dropped} dropped at decode); "
                 f"first error: {type(errors[0]).__name__}: {errors[0]}",
                 self.uid,
             ) from errors[0]

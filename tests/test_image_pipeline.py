@@ -203,8 +203,26 @@ def test_image_transformer_all_rows_failing_raises():
     t = ImageTransformer(input_col="image", output_col="out").resize(4, 4)
     boom = lambda img, *a: (_ for _ in ()).throw(RuntimeError("backend dead"))
     t._compile_ops = lambda: [(boom, [])]
-    with pytest.raises(FriendlyError, match="all 3 rows failed"):
+    with pytest.raises(
+        FriendlyError, match="all 3 rows that reached the op pipeline"
+    ):
         t.transform(ds)
+
+    # rows dropped at DECODE never reach the op pipeline and must not be
+    # counted as op failures; the message reports both tallies
+    ds_mixed = Dataset({
+        "image": [
+            b"not an image", b"also not an image",
+            ImageRow(path="ok", data=np.zeros((8, 8, 3), np.uint8)),
+        ],
+    })
+    t3 = ImageTransformer(input_col="image", output_col="out").resize(4, 4)
+    t3._compile_ops = lambda: [(boom, [])]
+    with pytest.raises(
+        FriendlyError,
+        match=r"all 1 rows that reached the op pipeline.*2 dropped at decode",
+    ):
+        t3.transform(ds_mixed)
 
     # one corrupt row among good ones still degrades to a drop
     t2 = ImageTransformer(input_col="image", output_col="out").resize(4, 4)

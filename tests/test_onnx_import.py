@@ -94,13 +94,16 @@ def value_info(name: str, shape) -> bytes:
 
 
 def model_proto(nodes, initializers, inputs, outputs,
-                gname="test") -> bytes:
+                gname="test", opset=None) -> bytes:
     g = b"".join(_msg(1, n) for n in nodes)
     g += _s(2, gname)
     g += b"".join(_msg(5, t) for t in initializers)
     g += b"".join(_msg(11, v) for v in inputs)
     g += b"".join(_msg(12, v) for v in outputs)
-    return _i(1, 8) + _msg(7, g)  # ir_version + graph
+    out = _i(1, 8) + _msg(7, g)  # ir_version + graph
+    if opset is not None:  # opset_import: default domain, given version
+        out += _msg(8, _s(1, "") + _i(2, opset))
+    return out
 
 
 # -- fixtures ----------------------------------------------------------------
@@ -522,6 +525,41 @@ def test_transformer_support_ops(rng):
     expect = w.sum(axis=1, keepdims=True)
     assert out.dtype == np.int32
     np.testing.assert_array_equal(out, expect)
+
+
+def test_split_uneven_opset_gate(rng):
+    """Split with no sizes and an indivisible dim: opset>=18 defines
+    ceil-sized chunks with a smaller tail; earlier opsets required an
+    even split (onnxruntime errors on them), so the importer refuses
+    rather than silently diverging. Unknown opset stays lenient."""
+    x = rng.normal(size=(2, 7)).astype(np.float32)
+
+    def build(opset):
+        return model_proto(
+            nodes=[node("Split", ["x"], ["a", "b"], name="split",
+                        attrs=[attr("axis", i=1)])],
+            initializers=[],
+            inputs=[value_info("x", (2, 7))],
+            outputs=[value_info("a", (2, 4))],
+            opset=opset,
+        )
+
+    g18 = load_onnx(build(18))
+    assert g18.opset == 18
+    np.testing.assert_allclose(
+        np.asarray(g18.apply(g18.init(), jnp.asarray(x))), x[:, :4]
+    )
+
+    g13 = load_onnx(build(13))
+    with pytest.raises(Exception, match="not divisible"):
+        g13.apply(g13.init(), jnp.asarray(x))
+
+    g_unknown = load_onnx(build(None))
+    assert g_unknown.opset is None
+    np.testing.assert_allclose(
+        np.asarray(g_unknown.apply(g_unknown.init(), jnp.asarray(x))),
+        x[:, :4],
+    )
 
 
 def test_shape_chain_constant_folds(rng):
