@@ -66,27 +66,62 @@ def quantile_edges(x: np.ndarray, max_bins: int) -> np.ndarray:
 
     Duplicate quantiles (constant / few-valued columns) collapse to +inf
     padding so they never split rows.
+
+    Fully vectorized: ONE column-wise sort plus fancy-indexed gathers
+    replace the per-column ``np.quantile`` loop — at TrainClassifier's
+    2^12 hashed dims the loop was 4096 sequential quantile calls per fit
+    (the reference offloads trees to MLlib; our host phase must not
+    dominate the device phase).
     """
-    d = x.shape[1]
+    n, d = x.shape
     qs = np.linspace(0.0, 1.0, max_bins + 1)[1:-1]
-    edges = np.full((d, max_bins - 1), np.inf, dtype=np.float64)
-    for j in range(d):
-        col = x[:, j]
-        col = col[np.isfinite(col)]
-        if col.size == 0:
-            continue
-        e = np.unique(np.quantile(col, qs))
-        e = e[e < col.max()]  # an edge >= max separates nothing
-        edges[j, : e.size] = e
-    return edges
+    # sort once; non-finite values (nan/±inf) become trailing nans so
+    # each column's finite prefix is its sorted finite sample
+    xf = np.where(np.isfinite(x), x, np.nan)
+    xs = np.sort(xf, axis=0)  # nans sort last
+    cnt = np.count_nonzero(~np.isnan(xf), axis=0)  # finite count per col
+    # linear-interpolated quantiles (np.quantile's default method) at
+    # virtual index q * (cnt - 1), gathered per column
+    v = qs[:, None] * (cnt[None, :] - 1).clip(min=0)  # [Q, d]
+    lo = np.floor(v).astype(np.intp)
+    hi = np.ceil(v).astype(np.intp)
+    cols = np.arange(d)[None, :]
+    elo = xs[lo, cols]
+    ehi = xs[hi, cols]
+    e = (elo + (v - lo) * (ehi - elo)).T  # [d, Q], rows sorted
+    # collapse duplicates and edges >= column max to +inf padding; the
+    # comparison is False for nan edges (empty columns) so those pad too
+    colmax = np.where(cnt > 0, xs[(cnt - 1).clip(min=0), np.arange(d)], np.nan)
+    bad = ~(e < colmax[:, None])
+    bad[:, 1:] |= e[:, 1:] == e[:, :-1]
+    e = np.where(bad, np.inf, e)
+    e.sort(axis=1)  # re-pack: finite edges left, +inf padding right
+    return e
 
 
 def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Bin values into [0, max_bins) codes via the per-column edges."""
-    n, d = x.shape
-    out = np.empty((n, d), dtype=np.int32)
-    for j in range(d):
-        out[:, j] = np.searchsorted(edges[j], x[:, j], side="right")
+    """Bin values into [0, max_bins) codes via the per-column edges.
+
+    Vectorized edge-major accumulation instead of d host searchsorted
+    calls: edge k of every column is applied in ONE whole-matrix compare,
+    restricted to the columns that still have a finite edge at position k.
+    Hashed-sparse featurization (2^12 dims, mostly few-valued columns)
+    exhausts its finite edges after the first couple of positions, so the
+    loop runs ~2-3 full-matrix ops instead of 4096 column ops. Matches
+    ``searchsorted(side='right')`` semantics incl. nan -> last bin (the
+    negated ``<`` keeps nan on the "past every edge" side).
+    """
+    xf = x.astype(np.float32, copy=False)
+    ef = edges.astype(np.float32, copy=False)
+    n, d = xf.shape
+    out = np.zeros((n, d), dtype=np.int32)
+    n_edges = np.isfinite(ef).sum(axis=1)  # finite prefix per column
+    for k in range(int(n_edges.max(initial=0))):
+        cols = np.flatnonzero(n_edges > k)
+        if cols.size == d:
+            out += ~(xf < ef[:, k])
+        else:
+            out[:, cols] += ~(xf[:, cols] < ef[cols, k])
     return out
 
 

@@ -74,6 +74,44 @@ def test_bin_features_monotone():
     assert bins.max() <= 15
 
 
+def test_binning_parity_with_per_column_reference():
+    """The vectorized one-sort quantile_edges / vmapped bin_features must
+    reproduce the straightforward per-column np.quantile/searchsorted
+    semantics they replaced (incl. nan/inf columns, constant columns,
+    few-valued columns, and empty columns)."""
+    rng = np.random.default_rng(3)
+    n, d, max_bins = 500, 23, 16
+    x = rng.normal(size=(n, d))
+    x[:, 0] = 1.0  # constant
+    x[:, 1] = rng.integers(0, 3, n)  # few-valued -> duplicate quantiles
+    x[rng.random((n, d)) < 0.05] = np.nan  # scattered missing
+    x[rng.random((n, d)) < 0.02] = np.inf
+    x[:, 2] = np.nan  # entirely empty column
+
+    qs = np.linspace(0.0, 1.0, max_bins + 1)[1:-1]
+    ref_edges = np.full((d, max_bins - 1), np.inf)
+    for j in range(d):
+        col = x[:, j][np.isfinite(x[:, j])]
+        if col.size == 0:
+            continue
+        e = np.unique(np.quantile(col, qs))
+        e = e[e < col.max()]
+        ref_edges[j, : e.size] = e
+    edges = quantile_edges(x, max_bins)
+    np.testing.assert_allclose(edges, ref_edges, rtol=1e-12, atol=0)
+
+    bins = bin_features(x, edges)
+    ref_bins = np.empty((n, d), dtype=np.int32)
+    xf32 = x.astype(np.float32)  # binning compares in f32
+    for j in range(d):
+        # reference = searchsorted against the FINITE edges: +inf padding
+        # separates nothing, so codes past the last finite edge are one
+        # routing-equivalent class (inf/nan land there too)
+        fin = edges[j][np.isfinite(edges[j])].astype(np.float32)
+        ref_bins[:, j] = np.searchsorted(fin, xf32[:, j], side="right")
+    np.testing.assert_array_equal(bins, ref_bins)
+
+
 # -- classification --------------------------------------------------------
 
 
