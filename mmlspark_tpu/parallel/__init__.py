@@ -34,6 +34,7 @@ from mmlspark_tpu.parallel.context_parallel import (  # noqa: F401
 )
 from mmlspark_tpu.parallel.sequence_rnn import (  # noqa: F401
     bilstm_seq_parallel_apply,
+    bilstm_seq_parallel_train_step,
 )
 from mmlspark_tpu.parallel.sharding import (  # noqa: F401
     TRANSFORMER_TP_RULES,

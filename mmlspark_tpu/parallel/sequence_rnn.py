@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["bilstm_seq_parallel_apply"]
+__all__ = ["bilstm_seq_parallel_apply", "bilstm_seq_parallel_train_step"]
 
 
 def _chunk_scan(cell, params, carry, xs, reverse: bool):
@@ -162,3 +162,69 @@ def bilstm_seq_parallel_apply(
     )
     ids = jax.device_put(ids, NamedSharding(mesh, io_spec))
     return fn(embed, fwd_p, bwd_p, head, jnp.asarray(ids))
+
+
+def bilstm_seq_parallel_train_step(
+    graph: Any,
+    variables: dict,
+    ids: jax.Array,
+    tags: jax.Array,
+    mesh: Mesh,
+    *,
+    learning_rate: float = 5e-2,
+    seq_axis: str = "seq",
+    data_axis: str | None = "data",
+):
+    """One jit-compiled SGD step with batch sharded over ``data_axis``
+    AND time sharded over ``seq_axis`` simultaneously — the mixed-axis
+    training leg for BASELINE config #5 (the reference trains its BiLSTM
+    DP-only inside CNTK; time sharding is the TPU-native long-context
+    upgrade). The backward runs through the chunked recurrence chain:
+    ``ppermute`` transposes to the reversed chain, and shard_map's
+    transpose inserts the gradient ``psum`` over both mesh axes for the
+    replicated parameters.
+
+    Returns ``(loss, new_variables)``; call repeatedly with the returned
+    variables. The compiled step is cached per (graph, mesh, lr, axes)
+    so a training loop pays one trace, not one per step.
+    """
+    key = (mesh, float(learning_rate), seq_axis, data_axis)
+    per_graph = _TRAIN_STEP_CACHE.setdefault(key, {})
+    hit = per_graph.get(id(graph))
+    fn = hit[0] if hit else None
+    if fn is None:
+
+        def step(variables, ids, tags):
+            def loss_fn(v):
+                logits = bilstm_seq_parallel_apply(
+                    graph, v, ids, mesh,
+                    seq_axis=seq_axis, data_axis=data_axis,
+                )
+                lp = jax.nn.log_softmax(logits)
+                ll = jnp.take_along_axis(lp, tags[..., None], axis=-1)
+                return -jnp.mean(ll)
+
+            loss, grads = jax.value_and_grad(loss_fn)(variables)
+            new_vars = jax.tree_util.tree_map(
+                lambda p, g: p - learning_rate * g, variables, grads
+            )
+            return loss, new_vars
+
+        fn = jax.jit(step)
+        # graph ref held in the value so the id key cannot be reused by
+        # a new object while this entry is alive; bound so a sweep over
+        # graphs/meshes/lrs cannot pin executables without limit (each
+        # entry holds compiled device buffers)
+        per_graph[id(graph)] = (fn, graph)
+        while sum(len(v) for v in _TRAIN_STEP_CACHE.values()) > _CACHE_MAX:
+            oldest_key = next(iter(_TRAIN_STEP_CACHE))
+            oldest = _TRAIN_STEP_CACHE[oldest_key]
+            oldest.pop(next(iter(oldest)), None)
+            if not oldest:
+                del _TRAIN_STEP_CACHE[oldest_key]
+    return fn(variables, jnp.asarray(ids), jnp.asarray(tags))
+
+
+#: (mesh, lr, seq_axis, data_axis) -> {id(graph): (jitted step, graph)}
+_TRAIN_STEP_CACHE: dict = {}
+_CACHE_MAX = 16
