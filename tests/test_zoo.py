@@ -198,3 +198,30 @@ def test_committed_real_backbone_scores_real_digits(tmp_path):
     scored = stage.transform(Dataset({"image": x}))
     acc = float((np.asarray(scored["scores"]).argmax(1) == y[:256]).mean())
     assert acc > 0.9, f"real backbone scores {acc} on unregistered digits"
+
+
+def test_evidence_backbone_accuracy_off_ceiling(tmp_path):
+    """ResNet20_Digits10 exists to keep the zoo's quality evidence
+    falsifiable: 10 classes at a 25% label budget land the recorded
+    held-out accuracy OFF the 1.0 ceiling (a saturated number cannot
+    distinguish a good backbone from a memorized one), while still being
+    high enough to prove the conv stack learns real scans."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.data.sample_data import load_digit_images
+
+    downloader = ModelDownloader(str(tmp_path), remote=_ZOO_REPO)
+    schema = downloader.download_by_name("ResNet20_Digits10")
+    acc = schema.extra.get("test_accuracy", None)
+    assert acc is not None
+    assert 0.75 < acc < 1.0, f"evidence accuracy saturated or weak: {acc}"
+    assert schema.extra.get("train_label_budget", "").startswith("25%")
+
+    # the payload itself scores unregistered scans of ALL ten classes
+    stage = PipelineStage.load(downloader.local_path(schema))
+    imgs, y = load_digit_images(
+        tuple(range(10)), max_shift=int(schema.extra["max_shift"]), seed=556
+    )
+    x = imgs[:256].astype(np.float32) / 255.0
+    scored = stage.transform(Dataset({"image": x}))
+    live = float((np.asarray(scored["scores"]).argmax(1) == y[:256]).mean())
+    assert live > 0.75, f"evidence backbone scores {live} live"

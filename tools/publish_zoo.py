@@ -66,25 +66,41 @@ def _train_and_publish(name, make_data, epochs, lr) -> None:
           f"{schema.size} bytes, held-out acc {acc:.3f})")
 
 
-def _train_and_publish_digits(name: str) -> None:
-    """The REAL-capability backbone: full-width ResNet-20 trained on the
-    scikit-learn handwritten-digit scans (real images), classes 0-4,
-    shift-augmented so its features survive unregistered inputs — the
-    transfer-learning property the reference zoo's ImageNet CNNs provide
-    (ModelDownloader.scala:109-155). e303 transfers it to digits 5-9."""
+def _train_and_publish_digits(
+    name: str,
+    classes: tuple = (0, 1, 2, 3, 4),
+    max_shift: int = 4,
+    copies: int = 8,
+    train_frac: float = 0.85,
+    epochs: int = 6,
+    min_acc: float = 0.9,
+) -> None:
+    """The REAL-capability backbones: full-width ResNet-20 trained on the
+    scikit-learn handwritten-digit scans (real images), shift-augmented so
+    the features survive unregistered inputs — the transfer-learning
+    property the reference zoo's ImageNet CNNs provide
+    (ModelDownloader.scala:109-155). Two published variants:
+
+    - ``ResNet20_Digits04`` (classes 0-4, 85% label budget): the e303/e305
+      transfer source — its features transfer to the UNSEEN digits 5-9.
+    - ``ResNet20_Digits10`` (all 10 classes, 25% label budget): the
+      EVIDENCE backbone. The 5-class/85%-label variant saturates its
+      held-out set (test_accuracy 1.0 — a ceiling that cannot distinguish
+      a good backbone from a memorized one); the 10-class low-label task
+      is hard enough that the recorded accuracy can move, so regressions
+      in the conv stack show up as a number, not a hidden ceiling."""
     from mmlspark_tpu.data.sample_data import load_digit_images
     from mmlspark_tpu.models import build_model
     from mmlspark_tpu.models.zoo import publish_model
     from mmlspark_tpu.stages.dnn_model import TPUModel
     from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
-
-    classes, max_shift, copies = (0, 1, 2, 3, 4), 4, 8
     # split by UNDERLYING image before augmenting: augmented copies of a
     # held-out digit must never appear in training
     _, y = load_digit_images(classes)
     n = len(y)
     order = np.random.default_rng(0).permutation(n)
-    tr_idx, te_idx = order[: int(0.85 * n)], order[int(0.85 * n):]
+    cut = int(train_frac * n)
+    tr_idx, te_idx = order[:cut], order[cut:]  # exact complements
     xs, ys = [], []
     for s in range(copies):
         imgs, _ = load_digit_images(classes, max_shift=max_shift, seed=s)
@@ -97,7 +113,7 @@ def _train_and_publish_digits(name: str) -> None:
     trainer = SPMDTrainer(
         graph,
         TrainConfig(
-            epochs=6, batch_size=128, learning_rate=2e-3,
+            epochs=epochs, batch_size=128, learning_rate=2e-3,
             optimizer="adam", lr_schedule="cosine", seed=0, log_every=50,
         ),
     )
@@ -107,7 +123,9 @@ def _train_and_publish_digits(name: str) -> None:
     hx = h_imgs[te_idx].astype(np.float32) / 255.0
     pred = np.asarray(graph.apply(variables, hx)).argmax(axis=1)
     acc = float((pred == y[te_idx]).mean())
-    assert acc > 0.9, f"{name}: held-out accuracy {acc} too low to publish"
+    assert acc > min_acc, (
+        f"{name}: held-out accuracy {acc} too low to publish"
+    )
 
     stage = TPUModel.from_graph(
         graph, variables, "resnet20_cifar10",
@@ -123,13 +141,15 @@ def _train_and_publish_digits(name: str) -> None:
             payload,
             input_node="image",
             layer_names=tuple(graph.layer_names),
-            dataset="sklearn-digits 0-4 (real handwritten scans), "
-                    f"shift-augmented ±{max_shift}px",
+            dataset=f"sklearn-digits {min(classes)}-{max(classes)} (real "
+                    f"handwritten scans), shift-augmented ±{max_shift}px",
             model_type="image-classifier",
             extra={
                 "input_scale": "1/255",
                 "classes": list(classes),
                 "max_shift": max_shift,
+                "train_label_budget": f"{train_frac:.0%} of scans, "
+                                      f"x{copies} shift copies",
                 "test_accuracy": round(acc, 4),
                 "test_condition": f"held-out digits, random ±{max_shift}px "
                                   "placement (unregistered)",
@@ -155,6 +175,12 @@ def main() -> None:
         # real data: trained on sklearn digit scans (see function doc)
         "ResNet20_Digits04": lambda: _train_and_publish_digits(
             "ResNet20_Digits04"
+        ),
+        # evidence backbone: 10 classes at a 25% label budget — held-out
+        # accuracy lands OFF the 1.0 ceiling so the number can move
+        "ResNet20_Digits10": lambda: _train_and_publish_digits(
+            "ResNet20_Digits10", classes=tuple(range(10)),
+            train_frac=0.25, copies=6, epochs=8, min_acc=0.75,
         ),
     }
     # republish only the named models (training is not bit-reproducible,
