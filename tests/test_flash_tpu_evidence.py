@@ -1,0 +1,75 @@
+"""Flash-kernel TPU evidence (VERDICT r3 missing #3).
+
+Two layers of proof that the Pallas flash kernels are real TPU kernels,
+not interpreter-only constructs:
+
+- on a real TPU backend, run a compiled (interpret=False) numerics check
+  directly (skipped on the CPU test mesh — the unit suite covers the same
+  code path in interpreter mode);
+- whenever a committed ``FLASH_TPU_EVIDENCE.json`` exists (produced by
+  ``tools/flash_tpu_evidence.py`` on the chip), validate its contract:
+  compiled mode, bf16 tolerances met for forward and all three grads in
+  both masking modes, and a non-empty block-sweep timing table.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+_EVIDENCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "FLASH_TPU_EVIDENCE.json",
+)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled flash kernels need the real chip; the CPU mesh "
+    "exercises the same kernels in interpreter mode",
+)
+def test_flash_compiled_matches_reference_on_tpu():
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 512, 4, 64)), jnp.bfloat16)
+        for _ in range(3)
+    )
+    out = np.asarray(
+        jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=False))(
+            q, k, v
+        ),
+        np.float32,
+    )
+    qf, kf, vf = (np.asarray(t, np.float32) for t in (q, k, v))
+    s = np.einsum("bqhd,bkhd->bhqk", qf, kf) * (64 ** -0.5)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, vf)
+    assert float(np.max(np.abs(out - want))) <= 1e-2
+
+
+@pytest.mark.skipif(
+    not os.path.exists(_EVIDENCE),
+    reason="no committed FLASH_TPU_EVIDENCE.json yet (tunnel never "
+    "healthy in-session); produced by tools/flash_tpu_evidence.py",
+)
+def test_flash_tpu_evidence_artifact_contract():
+    with open(_EVIDENCE, encoding="utf-8") as f:
+        ev = json.load(f)
+    assert ev["compiled"] is True and ev["interpret_mode"] is False
+    assert "tpu" in ev["device_kind"].lower() or "v5" in ev["device_kind"]
+    tol = ev["tolerance"]
+    for mode in ("full", "causal"):
+        n = ev["numerics"][mode]
+        assert n["fwd_max_abs_err"] <= tol
+        for key in ("dq", "dk", "dv"):
+            assert n[key] <= tol
+    assert ev["timing"], "block sweep missing"
+    for blk, t in ev["timing"].items():
+        assert t["fwd_ms"] > 0 and t["fwd_bwd_ms"] > 0, blk

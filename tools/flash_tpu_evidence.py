@@ -1,0 +1,174 @@
+"""Prove the Pallas flash kernels on REAL TPU (VERDICT r3 missing #3).
+
+The flash forward/backward kernels (ops/flash_attention.py) are exercised
+by the unit suite only in interpreter mode on the CPU mesh — a kernel that
+has only ever been interpreted is not yet a TPU kernel. This script runs
+OUTSIDE interpreter mode on the chip:
+
+1. compiles forward + backward at (B=4, S=2048, H=8, D=64) bfloat16,
+2. asserts numerics against the XLA einsum-softmax reference — forward
+   and all three input gradients within bf16 tolerance (<= 1e-2),
+   causal and non-causal,
+3. times a block-size sweep (128/256/512) of the compiled forward and
+   forward+backward around a forced host fetch (the axon relay makes
+   ``block_until_ready`` unreliable — see .claude/skills/verify),
+4. writes ``FLASH_TPU_EVIDENCE.json`` at the repo root for committing.
+
+A wedged tunnel is detected with a killable subprocess probe first, so
+the script fails fast with exit 2 instead of hanging.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "FLASH_TPU_EVIDENCE.json")
+
+B, S, H, D = 4, 2048, 8, 64
+BLOCKS = (128, 256, 512)
+TOL = 1e-2
+
+
+def _probe(timeout_s: float = 90.0) -> str:
+    code = "import jax; print(jax.default_backend(), jax.devices()[0].device_kind)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        raise SystemExit(2)
+    if r.returncode != 0 or "tpu" not in r.stdout:
+        print(f"no TPU backend: {r.stdout.strip()} {r.stderr.strip()[-200:]}")
+        raise SystemExit(2)
+    return r.stdout.strip()
+
+
+def _timed_best(fn, trials: int = 3) -> float:
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        np.asarray(fn())  # forced host fetch = sync point
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    print("probe:", _probe())
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.ops.flash_attention import flash_attention
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    rng = np.random.default_rng(0)
+    q, k, v, g = (
+        jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+        for _ in range(4)
+    )
+
+    def reference(q, k, v, causal):
+        # einsum-softmax in f32 on the same bf16 inputs
+        qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * (D ** -0.5)
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+
+    evidence: dict = {
+        "device_kind": kind,
+        "shape": {"B": B, "S": S, "H": H, "D": D, "dtype": "bfloat16"},
+        "tolerance": TOL,
+        "numerics": {},
+        "timing": {},
+    }
+
+    # -- numerics: compiled (interpret=False) vs XLA reference -------------
+    for causal in (False, True):
+        name = "causal" if causal else "full"
+        flash = jax.jit(
+            lambda q, k, v, c=causal: flash_attention(
+                q, k, v, causal=c, interpret=False
+            )
+        )
+        ref = jax.jit(lambda q, k, v, c=causal: reference(q, k, v, c))
+        out = np.asarray(flash(q, k, v), np.float32)
+        want = np.asarray(ref(q, k, v), np.float32)
+        fwd_err = float(np.max(np.abs(out - want)))
+
+        def loss_flash(q, k, v, c=causal):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=c, interpret=False)
+                .astype(jnp.float32) * g.astype(jnp.float32)
+            )
+
+        def loss_ref(q, k, v, c=causal):
+            return jnp.sum(reference(q, k, v, c) * g.astype(jnp.float32))
+
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        grad_errs = {
+            n: float(np.max(np.abs(
+                np.asarray(a, np.float32) - np.asarray(b, np.float32)
+            )))
+            for n, a, b in zip(("dq", "dk", "dv"), gf, gr)
+        }
+        evidence["numerics"][name] = {"fwd_max_abs_err": fwd_err,
+                                      **grad_errs}
+        assert fwd_err <= TOL, (name, fwd_err)
+        assert all(e <= TOL for e in grad_errs.values()), (name, grad_errs)
+        print(f"numerics[{name}]: fwd {fwd_err:.2e} grads "
+              + " ".join(f"{n}={e:.2e}" for n, e in grad_errs.items()))
+
+    # -- timing: block sweep, forward and forward+backward -----------------
+    attn_flops_fwd = 4 * B * H * S * S * D  # QK^T + PV matmuls
+    for blk in BLOCKS:
+        fwd = jax.jit(
+            lambda q, k, v, b=blk: flash_attention(
+                q, k, v, block=b, interpret=False
+            ).astype(jnp.float32).mean()
+        )
+
+        def loss(q, k, v, b=blk):
+            return jnp.sum(
+                flash_attention(q, k, v, block=b, interpret=False)
+                .astype(jnp.float32) * g.astype(jnp.float32)
+            )
+
+        fwdbwd = jax.jit(
+            lambda q, k, v, f=loss: sum(
+                t.astype(jnp.float32).sum()
+                for t in jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+            )
+        )
+        np.asarray(fwd(q, k, v)), np.asarray(fwdbwd(q, k, v))  # compile
+        t_f = _timed_best(lambda: fwd(q, k, v))
+        t_fb = _timed_best(lambda: fwdbwd(q, k, v))
+        evidence["timing"][f"block_{blk}"] = {
+            "fwd_ms": round(t_f * 1e3, 3),
+            "fwd_bwd_ms": round(t_fb * 1e3, 3),
+            "fwd_tflops_per_s": round(attn_flops_fwd / t_f / 1e12, 2),
+        }
+        print(f"block {blk}: fwd {t_f*1e3:.2f} ms "
+              f"({attn_flops_fwd / t_f / 1e12:.1f} TFLOP/s), "
+              f"fwd+bwd {t_fb*1e3:.2f} ms")
+
+    evidence["compiled"] = True
+    evidence["interpret_mode"] = False
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(evidence, f, indent=1)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
