@@ -88,7 +88,11 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   python tools/docgen.py
 
   step "bench smoke (one JSON line; real backend if available)"
-  python bench.py
+  # smoke semantics: a wedged tunnel should fall through to the CPU
+  # metric groups in ~minutes, not consume the driver-scale 20-min probe
+  # window (bench.py's default when invoked standalone)
+  MMLTPU_BENCH_PROBE_WINDOW_S=60 MMLTPU_BENCH_PROBE_TIMEOUT_S=45 \
+    python bench.py || test $? -eq 5  # 5 = no TPU headline (labeled CPU smoke)
 fi
 
 echo
