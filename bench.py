@@ -264,28 +264,38 @@ def bench_stage_inference(jax, graph, variables) -> dict:
     """Images/sec through the full TPUModel STAGE — host coercion, async
     host->HBM feed, compute, masked fetch. The product path that replaces
     the reference's per-minibatch JNI copy->evaluate->copy hot loop
-    (CNTKModel.scala:51-88); the model-only number above is its ceiling."""
+    (CNTKModel.scala:51-88); the model-only number above is its ceiling.
+    On TPU the feed depth (max in-flight batches) is swept — the
+    double-buffering lever from docs/PERFORMANCE.md — and the winner
+    reported, with per-depth figures recorded."""
     from mmlspark_tpu.data.dataset import Dataset
     from mmlspark_tpu.stages.dnn_model import TPUModel
 
-    batch = 1024 if _full_scale(jax) else 128
-    stage = TPUModel.from_graph(
-        graph, variables, "resnet20_cifar10",
-        input_col="image", output_col="scores", batch_size=batch,
-    )
-    n = 16384 if _full_scale(jax) else 512
+    full = _full_scale(jax)
+    batch = 1024 if full else 128
+    n = 16384 if full else 512
     x = np.random.default_rng(1).normal(size=(n, 32, 32, 3)).astype(
         np.float32
     )
     ds = Dataset({"image": x})
-    stage.transform(ds)  # warmup: compile + weight put
-    dt = min(_timed(lambda: stage.transform(ds)) for _ in range(3))
+    depths = (2, 4, 8) if full else (2,)
+    per_depth = {}
+    for depth in depths:
+        stage = TPUModel.from_graph(
+            graph, variables, "resnet20_cifar10",
+            input_col="image", output_col="scores", batch_size=batch,
+            feed_depth=depth,
+        )
+        stage.transform(ds)  # warmup: compile + weight put
+        dt = min(_timed(lambda: stage.transform(ds)) for _ in range(3))
+        per_depth[depth] = round(n / dt / jax.device_count(), 1)
+    best_depth = max(per_depth, key=per_depth.get)
     return {
-        "stage_images_per_sec_per_chip": round(
-            n / dt / jax.device_count(), 1
-        ),
+        "stage_images_per_sec_per_chip": per_depth[best_depth],
         "stage_batch_size": batch,
         "stage_rows": n,
+        "stage_feed_depth": best_depth,
+        "stage_per_depth": {str(k): v for k, v in per_depth.items()},
     }
 
 

@@ -54,6 +54,12 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         "shard batches over all visible devices (mesh data axis)", True,
         ptype=bool,
     )
+    feed_depth = Param(
+        "max in-flight batches in the async host->HBM pipeline (batch "
+        "i+1's copy overlaps batch i's compute; higher = more overlap, "
+        "more HBM held by pending outputs)", 2, ptype=int,
+        validator=positive,
+    )
 
     def __init__(self, **kwargs: Any):
         kwargs.setdefault("output_col", SCORES_COLUMN)
@@ -183,7 +189,7 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         # device_put and the jit dispatch are non-blocking, so batch i+1's
         # host->HBM copy overlaps batch i's compute; results are fetched a
         # few steps behind, bounding device-resident outputs.
-        max_inflight = 2
+        max_inflight = self.feed_depth
         inflight: list = []
         outs = []
 
