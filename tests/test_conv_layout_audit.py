@@ -1,0 +1,56 @@
+"""Conv dimension-number audit (docs/PERFORMANCE.md tuning lever #3).
+
+MXU efficiency on TPU depends on convolutions lowering to XLA's preferred
+layout: NHWC activations x HWIO kernels -> NHWC, with bf16 operands so
+the MXU runs native precision. This pins the property statically (lower,
+not compile) for both conv backbones — a regression to NCHW or a silent
+f32 upcast of the conv inputs shows up here long before an MFU number
+can.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mmlspark_tpu.models import build_model
+
+_PREFERRED = "[b, 0, 1, f]x[0, 1, i, o]->[b, 0, 1, f]"
+
+
+@pytest.mark.parametrize(
+    "name,kwargs,size",
+    [
+        ("resnet20_cifar10", {}, 32),
+        ("resnet50", {"input_size": 64}, 64),
+    ],
+)
+def test_convs_lower_nhwc_hwio_bf16(name, kwargs, size):
+    graph = build_model(name, **kwargs)
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3), jnp.float32)
+    )
+    txt = jax.jit(graph.apply).lower(
+        variables, jnp.zeros((2, size, size, 3), jnp.bfloat16)
+    ).as_text()
+
+    n_convs = txt.count("stablehlo.convolution")
+    assert n_convs > 10, f"{name}: expected a conv stack, saw {n_convs}"
+
+    dnums = set(
+        re.findall(r"dim_numbers = (\[[^\]]*\]x\[[^\]]*\]->\[[^\]]*\])", txt)
+    )
+    assert dnums == {_PREFERRED}, f"{name}: non-preferred conv layouts {dnums}"
+
+    # every conv consumes bf16 operands (activations AND kernels): the
+    # weights are cast to the compute dtype rather than pulling the MXU
+    # up to f32
+    operand_types = re.findall(
+        r"stablehlo.convolution.*?: \(tensor<([^>]*)>, tensor<([^>]*)>\)",
+        txt,
+    )
+    assert len(operand_types) == n_convs
+    bad = [t for t in operand_types if not (t[0].endswith("xbf16") and
+                                            t[1].endswith("xbf16"))]
+    assert not bad, f"{name}: non-bf16 conv operands {bad[:3]}"
