@@ -653,6 +653,7 @@ def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
             "hung" in error
             or "probe failed" in error
             or "UNAVAILABLE" in error
+            or "unreachable" in error
             or "hung" in probe
         )
         line["error_class"] = (
