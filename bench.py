@@ -228,23 +228,35 @@ def _chained_throughput(jax, jnp, graph, variables, x, iters, trials=3):
 
 
 def bench_inference(jax, jnp, graph, variables) -> dict:
-    """Images/sec/chip + MFU for ResNet-20 CIFAR inference."""
-    batch = 1024 if _full_scale(jax) else 128
-    x_host = np.random.default_rng(0).normal(size=(batch, 32, 32, 3))
-    # feed bfloat16: the model computes in bf16 regardless (MXU-native;
-    # logits stay f32), so an f32 input buffer only adds transfer bytes
-    x = jnp.asarray(x_host, jnp.bfloat16)
-    iters = 60 if _full_scale(jax) else 4
+    """Images/sec/chip + MFU for ResNet-20 CIFAR inference. On TPU the
+    batch size is swept (1024/4096) — the small 32x32 model leaves the
+    MXU underfilled, so a bigger batch is the one workload-preserving
+    lever for its arithmetic intensity; the winner is the headline and
+    both figures are recorded."""
+    full = _full_scale(jax)
+    iters = 60 if full else 4
+    rng = np.random.default_rng(0)
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
 
-    per_chip, flops_per_image = _chained_throughput(
-        jax, jnp, graph, variables, x, iters
-    )
+    per_batch: dict[int, tuple] = {}
+    for batch in (1024, 4096) if full else (128,):
+        # feed bfloat16: the model computes in bf16 regardless
+        # (MXU-native; logits stay f32), so an f32 input buffer only
+        # adds transfer bytes
+        x = jnp.asarray(
+            rng.normal(size=(batch, 32, 32, 3)), jnp.bfloat16
+        )
+        per_chip, fpi = _chained_throughput(
+            jax, jnp, graph, variables, x, iters
+        )
+        per_batch[batch] = (per_chip, fpi)
+    batch = max(per_batch, key=lambda b: per_batch[b][0])
+    per_chip, flops_per_image = per_batch[batch]
     flops_source = "xla_cost_analysis"
     if not flops_per_image:
         flops_per_image, flops_source = _RESNET20_FLOPS_PER_IMAGE, "analytic"
 
-    kind = jax.devices()[0].device_kind
-    peak = _peak_flops(kind)
     mfu = per_chip * flops_per_image / peak if peak else None
     return {
         "images_per_sec_per_chip": round(per_chip, 1),
@@ -254,6 +266,9 @@ def bench_inference(jax, jnp, graph, variables) -> dict:
         "device_kind": kind,
         "peak_bf16_flops": peak,
         "batch": batch,
+        "per_batch_images_per_sec": {
+            str(b): round(v[0], 1) for b, v in per_batch.items()
+        },
         "iters": iters,
         "input_dtype": "bfloat16",
         "timing": "best-of-3 trials, scan-chained iters, host-fetch sync",
