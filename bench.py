@@ -356,41 +356,53 @@ def bench_resnet50(jax, jnp) -> dict:
     )
     peak = _peak_flops(jax.devices()[0].device_kind)
 
-    def measure(variables):
+    def measure_with(g, variables):
         per_chip, fpi = _chained_throughput(
-            jax, jnp, graph, variables, x, iters
+            jax, jnp, g, variables, x, iters
         )
         mfu = per_chip * fpi / peak if peak and fpi else None
         return per_chip, mfu
 
-    f32_per_chip, f32_mfu = measure(variables)
-    # tuning lever #1 (docs/PERFORMANCE.md): bf16-resident weights halve
-    # the HBM weight traffic per forward. Report whichever variant wins
-    # as resnet50_mfu and record both so the lever's effect is auditable.
+    # weight-residency sweep (docs/PERFORMANCE.md lever #1 + the int8
+    # extension): bf16 weights halve and int8 weights quarter the HBM
+    # weight traffic per forward. Report the winner as resnet50_mfu and
+    # record every variant so the levers' effects are auditable.
+    from mmlspark_tpu.ops.quantize import dequantize_weights, quantize_weights
+
     bf16_vars = jax.tree_util.tree_map(
         lambda a: a.astype(jnp.bfloat16)
         if hasattr(a, "dtype") and a.dtype == jnp.float32
         else a,
         variables,
     )
-    bf16_per_chip, bf16_mfu = measure(bf16_vars)
-    if bf16_per_chip > f32_per_chip:
-        best, per_chip, mfu = "bf16_weights", bf16_per_chip, bf16_mfu
-    else:
-        best, per_chip, mfu = "f32_weights", f32_per_chip, f32_mfu
-    return {
+    qvars = quantize_weights(variables)
+    orig_apply = graph.apply
+
+    class _QuantGraph:
+        apply = staticmethod(
+            lambda v, x, **kw: orig_apply(dequantize_weights(v), x, **kw)
+        )
+
+    variants = {
+        "f32_weights": (graph, variables),
+        "bf16_weights": (graph, bf16_vars),
+        "int8_weights": (_QuantGraph, qvars),
+    }
+    results = {
+        name: measure_with(gr, vs) for name, (gr, vs) in variants.items()
+    }
+    best = max(results, key=lambda k: results[k][0])
+    per_chip, mfu = results[best]
+    out = {
         "resnet50_images_per_sec_per_chip": round(per_chip, 1),
         "resnet50_mfu": round(mfu, 4) if mfu is not None else None,
         "resnet50_input": size,
         "resnet50_batch": batch,
         "resnet50_weights": best,
-        "resnet50_mfu_f32_weights": (
-            round(f32_mfu, 4) if f32_mfu is not None else None
-        ),
-        "resnet50_mfu_bf16_weights": (
-            round(bf16_mfu, 4) if bf16_mfu is not None else None
-        ),
     }
+    for name, (_, m) in results.items():
+        out[f"resnet50_mfu_{name}"] = round(m, 4) if m is not None else None
+    return out
 
 
 def bench_train_classifier(jax) -> dict:

@@ -1,0 +1,103 @@
+"""Weight-only int8 quantization for inference pytrees.
+
+TPU inference at serving batch sizes is HBM-bandwidth-bound: each forward
+streams every weight byte from HBM once, so halving weight bytes raises
+the roofline directly. This module quantizes the LARGE arrays of a
+variables pytree (kernels, embeddings — ndim >= 2) to per-output-channel
+symmetric int8 with a float32 scale, leaving small tensors (biases, norm
+parameters) untouched. Dequantization happens INSIDE the jitted forward
+(int8 -> compute dtype, fused by XLA into the consuming conv/matmul), so
+the device-resident copy is int8 and the per-forward HBM weight traffic
+drops ~4x vs f32 / ~2x vs bf16.
+
+Scope is stated precisely: this is W8 (weight-only) — activations stay
+bf16, so the MXU still runs its bf16 path. It is a *bandwidth* lever,
+not an int8-MXU-throughput lever; accuracy cost is small (per-channel
+scales; see tests/test_quantize.py for the zoo-backbone agreement gate).
+
+The reference has no quantization anywhere (2017 CNTK inference is f32
+JNI); this is a TPU-native addition, available on ``TPUModel`` via
+``weight_quant="int8"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["quantize_weights", "dequantize_weights"]
+
+#: marker key: a dict {_Q8: int8 array, _SCALE: f32 per-channel scale}
+#: stands in for the original float leaf (pytree-transparent: device_put,
+#: serialization and tree_map all see plain dicts of arrays)
+_Q8 = "__w8__"
+_SCALE = "__w8_scale__"
+
+_MIN_QUANT_SIZE = 4096  # leave tiny tensors exact; no bandwidth to win
+
+
+def _is_quantized_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and _Q8 in x and _SCALE in x
+
+
+def quantize_weights(variables: Any) -> Any:
+    """Per-output-channel symmetric int8 for every float leaf with
+    ndim >= 2 and size >= 4096; everything else passes through."""
+
+    def one(leaf):
+        a = np.asarray(leaf)
+        # jnp.issubdtype, not dtype.kind: bfloat16 (ml_dtypes) has numpy
+        # kind 'V' and a kind check would silently skip bf16-resident
+        # weights — the exact tensors worth quantizing
+        if (
+            a.ndim < 2
+            or a.size < _MIN_QUANT_SIZE
+            or not jnp.issubdtype(a.dtype, jnp.floating)
+        ):
+            return leaf
+        flat = a.reshape(-1, a.shape[-1]).astype(np.float32)
+        scale = np.abs(flat).max(axis=0) / 127.0  # per output channel
+        scale = np.where(scale == 0.0, 1.0, scale)
+        q = np.clip(np.rint(flat / scale), -127, 127).astype(np.int8)
+        return {
+            _Q8: q.reshape(a.shape),
+            _SCALE: scale.astype(np.float32),
+        }
+
+    return jax.tree_util.tree_map(one, variables)
+
+
+def dequantize_weights(variables: Any, dtype=jnp.bfloat16) -> Any:
+    """Reconstruct compute-dtype weights from a quantized pytree — call
+    INSIDE jit so XLA fuses the int8 -> dtype convert into the consumer
+    and HBM holds only the int8 copy."""
+
+    def one(leaf):
+        if _is_quantized_leaf(leaf):
+            return (
+                leaf[_Q8].astype(dtype)
+                * leaf[_SCALE].astype(dtype)
+            )
+        return leaf
+
+    return jax.tree_util.tree_map(one, variables, is_leaf=_is_quantized_leaf)
+
+
+def quantized_bytes(variables: Any) -> tuple[int, int]:
+    """(bytes as stored, bytes if f32) — the bandwidth win, for logging."""
+    stored = 0
+    f32 = 0
+    for leaf in jax.tree_util.tree_leaves(
+        variables, is_leaf=_is_quantized_leaf
+    ):
+        if _is_quantized_leaf(leaf):
+            stored += leaf[_Q8].size + leaf[_SCALE].size * 4
+            f32 += leaf[_Q8].size * 4
+        else:
+            a = np.asarray(leaf)
+            stored += a.nbytes
+            f32 += a.size * 4
+    return stored, f32

@@ -60,6 +60,13 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         "more HBM held by pending outputs)", 2, ptype=int,
         validator=positive,
     )
+    weight_quant = Param(
+        "device-resident weight precision: 'none' keeps the trained "
+        "dtype; 'int8' stores kernels as per-channel symmetric int8 in "
+        "HBM and dequantizes to bf16 inside the jitted forward "
+        "(weight-only W8 — a bandwidth lever; see ops/quantize.py)",
+        "none", domain=("none", "int8"),
+    )
 
     def __init__(self, **kwargs: Any):
         kwargs.setdefault("output_col", SCORES_COLUMN)
@@ -113,12 +120,19 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         per-executor model clone being reused per partition)."""
         import jax
 
-        key = self.output_node
+        key = (self.output_node, self.weight_quant)
         if key not in self._jitted:
             graph = self.graph()
             node = self.output_node
+            quant = self.weight_quant
 
             def fwd(variables, x):
+                if quant == "int8":
+                    from mmlspark_tpu.ops.quantize import dequantize_weights
+
+                    # inside jit: XLA fuses the int8->bf16 convert into
+                    # the consuming conv/matmul; HBM holds int8
+                    variables = dequantize_weights(variables)
                 return graph.apply(variables, x, output_node=node)
 
             # donate the batch buffer: each batch is consumed exactly once,
@@ -138,9 +152,16 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         the same object)."""
         import jax
 
-        if getattr(self, "_dev_weights_src", None) is not self.weights:
-            self._dev_weights = jax.device_put(self.weights)
-            self._dev_weights_src = self.weights
+        src_key = (self.weights, self.weight_quant)
+        cached = getattr(self, "_dev_weights_src", (None, None))
+        if cached[0] is not src_key[0] or cached[1] != src_key[1]:
+            host = self.weights
+            if self.weight_quant == "int8":
+                from mmlspark_tpu.ops.quantize import quantize_weights
+
+                host = quantize_weights(host)
+            self._dev_weights = jax.device_put(host)
+            self._dev_weights_src = src_key
         return self._dev_weights
 
     def _sharding(self):

@@ -1,0 +1,104 @@
+"""Weight-only int8 quantization (ops/quantize.py + TPUModel.weight_quant).
+
+A TPU-native addition with no reference counterpart (2017 CNTK inference
+is f32 JNI): device-resident kernels stored int8 per-channel, dequantized
+to bf16 inside the jitted forward. The gates below keep it honest — exact
+pass-through for small tensors, bounded reconstruction error, a ~4x
+stored-bytes win, and near-perfect score agreement on the real-data zoo
+backbone.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.ops.quantize import (
+    dequantize_weights,
+    quantize_weights,
+    quantized_bytes,
+)
+
+
+def test_roundtrip_error_bounded_per_channel():
+    rng = np.random.default_rng(0)
+    # channels with wildly different magnitudes: per-channel scales must
+    # keep relative error small everywhere; a per-tensor scale would not
+    w = rng.normal(size=(64, 128)).astype(np.float32)
+    w *= np.logspace(-3, 2, 128)[None, :].astype(np.float32)
+    q = quantize_weights({"k": w})
+    back = np.asarray(dequantize_weights(q, dtype=np.float32)["k"])
+    scale = np.abs(w).max(axis=0) / 127.0
+    assert np.all(np.abs(back - w) <= scale[None, :] * 0.51 + 1e-9)
+
+
+def test_bf16_leaves_are_quantized():
+    """bfloat16 kernels (the repo's own bf16-resident lever) must NOT be
+    silently skipped: ml_dtypes' bfloat16 has numpy kind 'V', so a naive
+    dtype-kind check would pass them through unquantized."""
+    import jax.numpy as jnp
+
+    w = np.random.default_rng(2).normal(size=(128, 64)).astype(np.float32)
+    q = quantize_weights({"k": np.asarray(jnp.asarray(w, jnp.bfloat16))})
+    assert isinstance(q["k"], dict), "bf16 leaf skipped by quantizer"
+    back = np.asarray(dequantize_weights(q, dtype=np.float32)["k"])
+    assert np.abs(back - w).max() < 0.05
+
+
+def test_small_and_1d_tensors_pass_through():
+    tree = {
+        "bias": np.ones(64, np.float32),          # 1-D
+        "tiny": np.ones((8, 8), np.float32),      # < min size
+        "ints": np.arange(12).reshape(3, 4),      # non-float
+    }
+    q = quantize_weights(tree)
+    for k in tree:
+        np.testing.assert_array_equal(q[k], tree[k])
+
+
+def test_stored_bytes_shrink_4x():
+    w = np.random.default_rng(1).normal(size=(256, 256)).astype(np.float32)
+    q = quantize_weights({"k": w})
+    stored, f32 = quantized_bytes(q)
+    assert f32 == w.size * 4
+    assert stored < f32 / 3.8  # int8 + per-channel scales
+
+
+@pytest.mark.parametrize("quant", ["none", "int8"])
+def test_tpumodel_weight_quant_scores_agree(quant, tmp_path):
+    """TPUModel(weight_quant='int8') on the committed real-data backbone:
+    argmax agreement with the f32 path stays near-perfect and accuracy
+    holds on unregistered scans."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.data.dataset import Dataset
+    from mmlspark_tpu.data.sample_data import load_digit_images
+    from mmlspark_tpu.models.zoo import ModelDownloader
+
+    import os
+
+    zoo = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "models", "zoo_repo",
+    )
+    dl = ModelDownloader(str(tmp_path), remote=zoo)
+    schema = dl.download_by_name("ResNet20_Digits10")
+    stage = PipelineStage.load(dl.local_path(schema))
+    imgs, y = load_digit_images(tuple(range(10)), max_shift=4, seed=321)
+    x = imgs[:200].astype(np.float32) / 255.0
+    ds = Dataset({"image": x})
+
+    base_raw = np.asarray(stage.transform(ds)["scores"])
+    base = base_raw.argmax(1)
+    if quant == "none":
+        acc = float((base == y[:200]).mean())
+        assert acc > 0.75, acc
+        return
+    stage.weight_quant = "int8"
+    q_raw = np.asarray(stage.transform(ds)["scores"])
+    # the quantized path must actually have engaged: int8 reconstruction
+    # perturbs the logits (identical outputs would mean a stale cache
+    # silently served the f32 weights)
+    assert not np.array_equal(q_raw, base_raw)
+    q_scores = q_raw.argmax(1)
+    agree = float((q_scores == base).mean())
+    assert agree >= 0.97, f"int8 argmax agreement {agree}"
+    acc = float((q_scores == y[:200]).mean())
+    assert acc > 0.75, f"int8 accuracy {acc}"
