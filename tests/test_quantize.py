@@ -102,3 +102,40 @@ def test_tpumodel_weight_quant_scores_agree(quant, tmp_path):
     assert agree >= 0.97, f"int8 argmax agreement {agree}"
     acc = float((q_scores == y[:200]).mean())
     assert acc > 0.75, f"int8 accuracy {acc}"
+
+
+def test_image_featurizer_preserves_weight_quant(tmp_path):
+    """ImageFeaturizer copies its TPUModel (explicit params included), so
+    a quantized backbone stays quantized through the transfer-learning
+    path — features shift slightly but stay strongly aligned."""
+    import os
+
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.data.dataset import Dataset
+    from mmlspark_tpu.data.sample_data import load_digit_images
+    from mmlspark_tpu.models.zoo import ModelDownloader
+    from mmlspark_tpu.stages.image import ImageFeaturizer
+
+    zoo = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "models", "zoo_repo",
+    )
+    dl = ModelDownloader(str(tmp_path), remote=zoo)
+    stage = PipelineStage.load(
+        dl.local_path(dl.download_by_name("ResNet20_Digits10"))
+    )
+    imgs, _ = load_digit_images(tuple(range(10)), max_shift=4, seed=9)
+    ds = Dataset({"image": imgs[:64].astype(np.float32) / 255.0})
+
+    def feats(quant):
+        stage.weight_quant = quant
+        f = ImageFeaturizer(model=stage, cut_output_layers=1)
+        return np.asarray(f.transform(ds)["features"], np.float32)
+
+    f32 = feats("none")
+    q8 = feats("int8")
+    assert f32.shape == q8.shape and f32.ndim == 2
+    assert not np.array_equal(f32, q8), "int8 did not engage through copy"
+    num = (f32 * q8).sum(axis=1)
+    den = np.linalg.norm(f32, axis=1) * np.linalg.norm(q8, axis=1) + 1e-9
+    assert float((num / den).min()) > 0.99, "features diverged"
