@@ -675,8 +675,10 @@ def run(attempt: int) -> dict:
         "flash": lambda: bench_flash(jax, jnp),
     }
     errors: dict[str, str] = {}
+    # generous: six groups with batch/depth/weight sweeps compile ~15+
+    # programs at 20-40s each on the relay before any timing starts
     metric_wd = _watchdog(
-        float(os.environ.get("MMLTPU_BENCH_METRIC_TIMEOUT_S", "1200")),
+        float(os.environ.get("MMLTPU_BENCH_METRIC_TIMEOUT_S", "2400")),
         attempt,
         "metric phase",
     )
