@@ -38,6 +38,8 @@ TITLES = {
     "e303": "303 - Transfer Learning by DNN Featurization",
     "e304": "304 - Medical Entity Extraction (BiLSTM)",
     "e305": "305 - ImageFeaturizer: basic vs DNN featurization",
+    # beyond the reference's ten: TPU-native long-context story
+    "e306": "306 - Long-Context Ring Attention (sequence parallelism)",
 }
 
 
