@@ -1,0 +1,93 @@
+"""Capture a jax.profiler trace of the ResNet-50 forward on the chip.
+
+VERDICT r3 prescription #2: if `resnet50_mfu` lands below the 0.40
+target, commit profiler evidence of the residual blocker. This script
+produces that evidence: a device trace of the compiled forward (the same
+program the bench times) written under ``profiles/resnet50/`` plus a
+printed summary of where the step time goes. Run it on a healthy tunnel:
+
+    python tools/profile_resnet50.py [--size 224 --batch 256]
+
+A wedged tunnel is detected with a killable probe first (exit 2).
+TensorBoard reads the trace directory; the raw .pb/.json.gz files are
+small enough to commit alongside BENCH_LOCAL artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _probe(timeout_s: float = 90.0) -> None:
+    code = "import jax; print(jax.default_backend())"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("tunnel wedged (probe hung)")
+        raise SystemExit(2)
+    if r.returncode != 0 or "tpu" not in r.stdout:
+        print(f"no TPU backend: {r.stdout.strip()}")
+        raise SystemExit(2)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "profiles", "resnet50")
+    )
+    args = ap.parse_args()
+    _probe()
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+
+    graph = build_model("resnet50", input_size=args.size)
+    variables = graph.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, args.size, args.size, 3), jnp.float32),
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(
+            size=(args.batch, args.size, args.size, 3)
+        ),
+        jnp.bfloat16,
+    )
+    fwd = jax.jit(lambda v, x: graph.apply(v, x).mean())
+    np.asarray(fwd(variables, x))  # compile outside the trace
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(args.iters):
+            np.asarray(fwd(variables, x))  # host fetch = sync per step
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        np.asarray(fwd(variables, x))
+    dt = (time.perf_counter() - t0) / args.iters
+    print(
+        f"traced {args.iters} steps -> {args.out}\n"
+        f"untraced step: {dt * 1e3:.2f} ms "
+        f"({args.batch / dt:.0f} img/s) at ({args.batch}, {args.size})\n"
+        "inspect: tensorboard --logdir "
+        + args.out
+    )
+
+
+if __name__ == "__main__":
+    main()
