@@ -57,6 +57,25 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_evidence(args) -> int:
+    """Run a repo evidence tool (flash kernels / resnet50 profile) on the
+    real backend — thin launcher so the proofs are one command away."""
+    _apply_backend(args)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = {
+        "flash": "flash_tpu_evidence.py",
+        "profile": "profile_resnet50.py",
+    }[args.which]
+    path = os.path.join(repo, "tools", script)
+    if not os.path.exists(path):
+        print(f"{script} not found (installed package without the repo)",
+              file=sys.stderr)
+        return 2
+    sys.argv = [path, *args.tool_args]
+    runpy.run_path(path, run_name="__main__")
+    return 0
+
+
 def cmd_docgen(args) -> int:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.join(repo, "tools"))
@@ -128,6 +147,13 @@ def main(argv: list[str] | None = None) -> int:
 
     sp = sub.add_parser("bench", help="run the repo benchmark")
     sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser(
+        "evidence", help="run a TPU evidence tool (flash | profile)"
+    )
+    sp.add_argument("which", choices=["flash", "profile"])
+    sp.add_argument("tool_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_evidence)
 
     sp = sub.add_parser("docgen", help="regenerate API docs")
     sp.add_argument("out_dir", nargs="?", default="docs/api")
