@@ -256,3 +256,35 @@ def test_depthwise_conv_import_matches_torch(tmp_path):
     assert "Clip" in ops  # ReLU6
     got = np.asarray(graph.apply(graph.init(), jnp.asarray(x.numpy())))
     np.testing.assert_allclose(got, y.numpy(), atol=1e-5, rtol=1e-5)
+
+
+class _BiLSTMTagger(nn.Module):
+    """Notebook-304-shaped net from a REAL exporter: embedding ->
+    bidirectional LSTM -> per-token linear head. torch exports this as
+    Gather + ONNX LSTM(direction=bidirectional) + Transpose/Reshape +
+    Gemm — the opaque-serialized-BiLSTM family CNTKModel served."""
+
+    def __init__(self, vocab=23, embed=12, hidden=8, tags=5):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, embed)
+        self.lstm = nn.LSTM(embed, hidden, bidirectional=True)
+        self.head = nn.Linear(2 * hidden, tags)
+
+    def forward(self, ids):  # ids: (T, B) int64
+        h, _ = self.lstm(self.emb(ids))
+        return self.head(h)  # (T, B, tags)
+
+
+def test_bilstm_import_matches_torch(tmp_path):
+    torch.manual_seed(3)
+    model = _BiLSTMTagger().eval()
+    ids = torch.randint(0, 23, (9, 2))
+    with torch.no_grad():
+        y = model(ids)
+    path = tmp_path / "bilstm.onnx"
+    _export_onnx(model, (ids,), path)
+    graph = load_onnx(str(path))
+    got = np.asarray(
+        graph.apply(graph.init(), jnp.asarray(ids.numpy().astype(np.int32)))
+    )
+    np.testing.assert_allclose(got, y.numpy(), atol=1e-4, rtol=1e-4)
