@@ -687,13 +687,19 @@ def run(attempt: int) -> dict:
             if _group_done(results, group):
                 continue
             try:
+                t0 = time.perf_counter()
                 metrics = fn()
-                # per-group provenance: a fallback attempt can land some
-                # groups on cpu after earlier attempts landed others on
-                # tpu — the line must say which numbers are which
-                gb = {**_scratch_load().get("group_backends", {}),
-                      group: backend}
-                results = _scratch_merge({**metrics, "group_backends": gb})
+                # per-group provenance + cost: a fallback attempt can
+                # land some groups on cpu after earlier attempts landed
+                # others on tpu — the line must say which numbers are
+                # which, and what each group cost (compile included)
+                prior = _scratch_load()
+                gb = {**prior.get("group_backends", {}), group: backend}
+                gs = {**prior.get("group_seconds", {}),
+                      group: round(time.perf_counter() - t0, 1)}
+                results = _scratch_merge(
+                    {**metrics, "group_backends": gb, "group_seconds": gs}
+                )
             except Exception as e:  # noqa: BLE001 — per-group isolation
                 errors[group] = f"{type(e).__name__}: {e}"
     finally:
