@@ -114,8 +114,12 @@ def _peak_flops(device_kind: str) -> float | None:
 
 def _full_scale(jax) -> bool:
     """TPU runs at full size; other backends (CPU smoke) run tiny so the
-    whole bench stays inside a smoke-test budget. The JSON records which."""
-    return jax.default_backend() == "tpu"
+    whole bench stays inside a smoke-test budget. The JSON records which.
+    Device-kind-robust: the axon relay registers platform 'axon' while
+    proxying a real chip."""
+    from mmlspark_tpu.core.env import is_tpu
+
+    return is_tpu()
 
 
 # --------------------------------------------------------------------------

@@ -39,7 +39,21 @@ def backend() -> str:
 
 
 def is_tpu() -> bool:
-    return backend() == "tpu"
+    """True when the default backend drives real TPU silicon. Robust to
+    relay/plugin platforms that register under another name (the axon
+    tunnel registers platform 'axon' while proxying a TPU chip): the
+    device_kind, not just the platform string, decides."""
+    if backend() == "tpu":
+        return True
+    import re
+
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # no devices / uninitialized backend
+        return False
+    return "tpu" in kind or bool(re.match(r"v\d", kind))
 
 
 @dataclass(frozen=True)

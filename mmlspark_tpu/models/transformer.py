@@ -43,9 +43,9 @@ def resolve_attn_impl(attn_impl: str) -> str:
     interpreter-mode kernel would crawl on CPU test meshes)."""
     if attn_impl != AUTO:
         return attn_impl
-    import jax
+    from mmlspark_tpu.core.env import is_tpu
 
-    return FLASH if jax.default_backend() == "tpu" else DENSE
+    return FLASH if is_tpu() else DENSE
 
 
 class TokenPosEmbed(nn.Module):

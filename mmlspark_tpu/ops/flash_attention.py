@@ -368,5 +368,7 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None,
     padded keys are masked, padded query rows are sliced away.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from mmlspark_tpu.core.env import is_tpu
+
+        interpret = not is_tpu()
     return _build(causal, scale, block, bool(interpret))(q, k, v)

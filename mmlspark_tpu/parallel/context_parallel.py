@@ -89,7 +89,9 @@ def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool, scale):
     a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # (B, S/n, H, D) -> (B, S, H/n, D): split heads, concat sequence
     q, k, v = (a2a(t, split_axis=2, concat_axis=1) for t in (q, k, v))
-    if jax.default_backend() == "tpu":
+    from mmlspark_tpu.core.env import is_tpu
+
+    if is_tpu():
         from mmlspark_tpu.ops.flash_attention import flash_attention
 
         o = flash_attention(q, k, v, causal=causal, scale=scale)

@@ -138,7 +138,9 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             # donate the batch buffer: each batch is consumed exactly once,
             # so XLA can reuse its HBM for the outputs (CPU backend has no
             # donation and would warn per call)
-            donate = (1,) if jax.default_backend() == "tpu" else ()
+            from mmlspark_tpu.core.env import is_tpu
+
+            donate = (1,) if is_tpu() else ()
             self._jitted[key] = jax.jit(fwd, donate_argnums=donate)
         return self._jitted[key]
 

@@ -189,3 +189,25 @@ def test_pipeline_skips_transform_after_last_estimator(basic_dataset):
     # neither the fitted model of the last estimator nor the trailing
     # transformer should have run during fit
     assert calls == []
+
+
+def test_is_tpu_recognizes_relay_platform(monkeypatch):
+    """The axon relay registers platform 'axon' while proxying a real
+    chip; is_tpu() must key on device_kind, not just the platform name —
+    a platform-name-only check would silently run interpreter-mode
+    kernels and smoke-scale benches ON the TPU."""
+    from mmlspark_tpu.core import env
+
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    monkeypatch.setattr(env, "backend", lambda: "axon")
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: [_Dev("TPU v5 lite")])
+    assert env.is_tpu()
+    monkeypatch.setattr(jax, "devices", lambda: [_Dev("v6e")])
+    assert env.is_tpu()
+    monkeypatch.setattr(jax, "devices", lambda: [_Dev("cpu")])
+    assert not env.is_tpu()

@@ -25,8 +25,14 @@ _EVIDENCE = os.path.join(
 )
 
 
+def _on_tpu() -> bool:
+    from mmlspark_tpu.core.env import is_tpu
+
+    return is_tpu()
+
+
 @pytest.mark.skipif(
-    jax.default_backend() != "tpu",
+    not _on_tpu(),
     reason="compiled flash kernels need the real chip; the CPU mesh "
     "exercises the same kernels in interpreter mode",
 )

@@ -45,7 +45,7 @@ def _probe(timeout_s: float = 90.0) -> str:
         )
     except subprocess.TimeoutExpired:
         raise SystemExit(2)
-    if r.returncode != 0 or "tpu" not in r.stdout:
+    if r.returncode != 0 or "tpu" not in r.stdout.lower():
         print(f"no TPU backend: {r.stdout.strip()} {r.stderr.strip()[-200:]}")
         raise SystemExit(2)
     return r.stdout.strip()
@@ -65,9 +65,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from mmlspark_tpu.core.env import is_tpu
     from mmlspark_tpu.ops.flash_attention import flash_attention
 
-    assert jax.default_backend() == "tpu", jax.default_backend()
+    assert is_tpu(), (jax.default_backend(), jax.devices()[0].device_kind)
     kind = jax.devices()[0].device_kind
     rng = np.random.default_rng(0)
     q, k, v, g = (

@@ -27,7 +27,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _probe(timeout_s: float = 90.0) -> None:
-    code = "import jax; print(jax.default_backend())"
+    code = (
+        "import jax; "
+        "print(jax.default_backend(), jax.devices()[0].device_kind)"
+    )
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
@@ -36,7 +39,7 @@ def _probe(timeout_s: float = 90.0) -> None:
     except subprocess.TimeoutExpired:
         print("tunnel wedged (probe hung)")
         raise SystemExit(2)
-    if r.returncode != 0 or "tpu" not in r.stdout:
+    if r.returncode != 0 or "tpu" not in r.stdout.lower():
         print(f"no TPU backend: {r.stdout.strip()}")
         raise SystemExit(2)
 
