@@ -652,10 +652,13 @@ def run(attempt: int) -> dict:
         # retry envelope, not be shot mid-backoff with a bogus "hung"
         watchdog.cancel()
 
-    backend = jax.default_backend()
+    # canonical name: the relay registers platform 'axon' for a real
+    # chip; provenance labels (group_backends, scale logic) key on 'tpu'
+    backend = "tpu" if _full_scale(jax) else jax.default_backend()
     results = _scratch_merge({
         "devices": jax.device_count(),
         "backend": backend,
+        "platform": jax.default_backend(),
     })
 
     # each group: skip if a previous attempt already landed it; run under
