@@ -774,24 +774,25 @@ def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
         line["error_class"] = (
             "backend_unreachable" if unreachable else "bench_failure"
         )
+    # the headline field means "per-chip TPU number": a figure measured
+    # on any other backend must NOT occupy it (a driver keying on value /
+    # exit code would record it as the first real baseline). The executed
+    # measurement stays in the body, labeled by group_backends.
+    primary_backend = results.get("group_backends", {}).get("inference")
+    if line.get("value") is not None and primary_backend != "tpu":
+        line["images_per_sec_per_chip"] = line["value"]
+        line["value"] = None
     if _cpu_smoke_mode():
         # ``error_class`` is NOT forced here: the generic classifier above
         # already labels tunnel-shaped reasons unreachable, and a genuine
         # bench-code crash during the smoke run must keep bench_failure.
         # Scale label is per the PRIMARY metric's provenance — a TPU
         # number landed by an earlier attempt stays labeled tpu.
-        gb = results.get("group_backends", {})
-        if gb.get("inference") == "tpu":
-            line["scale"] = "partial_tpu_then_cpu_smoke"
-        else:
-            line["scale"] = "cpu_smoke"
-            # the headline field means "per-chip TPU number": a CPU smoke
-            # figure must NOT occupy it (a driver keying on value/exit
-            # code would record it as the first real baseline). The
-            # executed CPU measurement stays in the body, labeled.
-            if line.get("value") is not None:
-                line["images_per_sec_per_chip"] = line["value"]
-                line["value"] = None
+        line["scale"] = (
+            "partial_tpu_then_cpu_smoke"
+            if primary_backend == "tpu"
+            else "cpu_smoke"
+        )
     if attempt > 1:
         line["attempts"] = attempt
     return line
