@@ -1,0 +1,99 @@
+"""Unit tests for bench.py's emission envelope (no backend needed).
+
+The envelope is the part the driver depends on when everything else goes
+wrong (BENCH_r01-r03 all failed differently), so its rules are pinned
+directly: headline-value provenance, failure classification, smoke-mode
+labeling, and scratch persistence.
+"""
+
+import importlib
+import json
+import os
+import sys
+
+
+def _bench(monkeypatch, tmp_path, **env):
+    monkeypatch.setenv("MMLTPU_BENCH_SCRATCH", str(tmp_path / "scratch.json"))
+    monkeypatch.delenv("MMLTPU_BENCH_CPU_SMOKE", raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import bench
+
+    return importlib.reload(bench)
+
+
+def test_headline_null_unless_tpu_provenance(monkeypatch, tmp_path):
+    bench = _bench(monkeypatch, tmp_path)
+    cpu = bench._final_line(
+        {"images_per_sec_per_chip": 700.0,
+         "group_backends": {"inference": "cpu"}},
+        attempt=1,
+    )
+    assert cpu["value"] is None
+    assert cpu["images_per_sec_per_chip"] == 700.0  # stays in the body
+
+    tpu = bench._final_line(
+        {"images_per_sec_per_chip": 427020.0,
+         "group_backends": {"inference": "tpu"}},
+        attempt=1,
+    )
+    assert tpu["value"] == 427020.0
+    assert "images_per_sec_per_chip" not in tpu or tpu["value"] is not None
+
+
+def test_smoke_mode_scale_labels(monkeypatch, tmp_path):
+    bench = _bench(monkeypatch, tmp_path, MMLTPU_BENCH_CPU_SMOKE="1")
+    smoke = bench._final_line(
+        {"images_per_sec_per_chip": 700.0,
+         "group_backends": {"inference": "cpu"}},
+        attempt=3, error="backend probe failed: probe hung >60s",
+    )
+    assert smoke["scale"] == "cpu_smoke"
+    assert smoke["value"] is None
+    assert smoke["error_class"] == "backend_unreachable"
+
+    partial = bench._final_line(
+        {"images_per_sec_per_chip": 427020.0,
+         "group_backends": {"inference": "tpu", "train": "cpu"}},
+        attempt=3, error="TPU unreachable",
+    )
+    assert partial["scale"] == "partial_tpu_then_cpu_smoke"
+    assert partial["value"] == 427020.0
+
+
+def test_error_classifier(monkeypatch, tmp_path):
+    bench = _bench(monkeypatch, tmp_path)
+    for err, cls in [
+        ("backend init hung for 900s (watchdog)", "backend_unreachable"),
+        ("backend probe failed: spawn error", "backend_unreachable"),
+        ("RPC UNAVAILABLE: relay", "backend_unreachable"),
+        ("TPU unreachable", "backend_unreachable"),
+        ("TypeError: bad shape", "bench_failure"),
+    ]:
+        line = bench._final_line({}, attempt=3, error=err)
+        assert line["error_class"] == cls, (err, line["error_class"])
+
+
+def test_probe_key_dropped_on_success_kept_on_failure(monkeypatch, tmp_path):
+    bench = _bench(monkeypatch, tmp_path)
+    ok = bench._final_line({"probe": "1 tpu TPU v5 lite"}, attempt=1)
+    assert "probe" not in ok
+    bad = bench._final_line(
+        {"probe": "probe hung >60s"}, attempt=2, error="x failed"
+    )
+    assert bad["probe"] == "probe hung >60s"
+
+
+def test_scratch_merge_roundtrip_and_missing_groups(monkeypatch, tmp_path):
+    bench = _bench(monkeypatch, tmp_path)
+    merged = bench._scratch_merge({"images_per_sec_per_chip": 1.0, "mfu": 0.1})
+    assert bench._group_done(merged, "inference")
+    assert not bench._group_done(merged, "flash")
+    line = bench._final_line(bench._scratch_load(), attempt=1)
+    assert set(line["missing_metrics"]) == {
+        "stage", "resnet50", "train", "trees", "flash"
+    }
+    # merge is a real file round-trip: a fresh load sees the update
+    with open(os.environ["MMLTPU_BENCH_SCRATCH"], encoding="utf-8") as f:
+        assert json.load(f)["mfu"] == 0.1
