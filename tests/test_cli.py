@@ -86,3 +86,11 @@ def test_multihost_env_contract(monkeypatch):
     monkeypatch.setattr(jax, "distributed", FakeDistributed)
     mesh.initialize_distributed()
     assert calls == {"addr": "10.0.0.1:8476", "n": 4, "pid": 2}
+
+
+def test_evidence_flash_probe_gates_off_tpu():
+    """`mml-tpu evidence flash` reaches the proof tool; on a CPU-only
+    backend the tool's probe refuses with exit 2 (never hangs)."""
+    r = _run("evidence", "flash")
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "no TPU backend" in r.stdout
