@@ -681,6 +681,19 @@ def run(attempt: int) -> dict:
         "trees": lambda: bench_trees(jax),
         "flash": lambda: bench_flash(jax, jnp),
     }
+    # MMLTPU_BENCH_GROUPS=resnet50,inference runs a subset — lets a
+    # short-lived healthy tunnel spend its minutes on the headline
+    # metrics instead of the full sweep (unlisted groups are reported
+    # as skipped, not missing-by-failure)
+    only = os.environ.get("MMLTPU_BENCH_GROUPS", "")
+    if only:
+        wanted = {g.strip() for g in only.split(",") if g.strip()}
+        unknown = wanted - set(runners)
+        if unknown:
+            raise RuntimeError(
+                f"MMLTPU_BENCH_GROUPS names unknown groups {sorted(unknown)}"
+            )
+        runners = {g: fn for g, fn in runners.items() if g in wanted}
     errors: dict[str, str] = {}
     # generous: six groups with batch/depth/weight sweeps compile ~15+
     # programs at 20-40s each on the relay before any timing starts
@@ -720,10 +733,12 @@ def run(attempt: int) -> dict:
         g: msg for g, msg in group_errors.items()
         if not (g in _GROUPS and _group_done(results, g))
     }
+    if only:
+        results = _scratch_merge({"groups_filter": sorted(runners)})
     results = _scratch_merge({"group_errors": group_errors})
     # retry-worthy only if a group failed AND attempts remain — the scratch
     # file ensures the retry runs just the missing groups
-    missing = [g for g in _GROUPS if not _group_done(results, g)]
+    missing = [g for g in runners if not _group_done(results, g)]
     if missing and attempt < _MAX_ATTEMPTS and not _cpu_smoke_mode():
         raise RuntimeError(f"metric groups failed: {missing}: {errors}")
     if _cpu_smoke_mode():
@@ -740,7 +755,8 @@ def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
     """Assemble the single output line from whatever the scratch holds."""
     results = dict(results)
     results.pop("fallback_reason", None)  # folded into ``error`` below
-    missing = [g for g in _GROUPS if not _group_done(results, g)]
+    expected = results.get("groups_filter") or list(_GROUPS)
+    missing = [g for g in expected if not _group_done(results, g)]
     line = {
         "metric": _PRIMARY_METRIC,
         "value": results.pop("images_per_sec_per_chip", None),
