@@ -299,6 +299,10 @@ def bench_stage_inference(jax, graph, variables) -> dict:
     )
     ds = Dataset({"image": x})
     depths = (2, 4, 8) if full else (2,)
+    # best-of-2 (not 3): the r4 TPU run clocked this group at 543 s of
+    # the 2400 s watchdog — each full-scale transform moves ~200 MB
+    # host->HBM, so trials are the expensive axis here
+    trials = 2 if full else 3
     per_depth = {}
     for depth in depths:
         stage = TPUModel.from_graph(
@@ -307,7 +311,7 @@ def bench_stage_inference(jax, graph, variables) -> dict:
             feed_depth=depth,
         )
         stage.transform(ds)  # warmup: compile + weight put
-        dt = min(_timed(lambda: stage.transform(ds)) for _ in range(3))
+        dt = min(_timed(lambda: stage.transform(ds)) for _ in range(trials))
         per_depth[depth] = round(n / dt / jax.device_count(), 1)
     best_depth = max(per_depth, key=per_depth.get)
     # reference-shaped comparison row: the reference's hot loop evaluates
@@ -316,7 +320,7 @@ def bench_stage_inference(jax, graph, variables) -> dict:
     # hardware, same stage, batch_size=10 + feed_depth=1 mimics that
     # shape — the gap to the headline number is what large batches + the
     # async feed buy.
-    ref_rows = min(n, 2048 if full else 256)
+    ref_rows = min(n, 1024 if full else 256)
     ref_stage = TPUModel.from_graph(
         graph, variables, "resnet20_cifar10",
         input_col="image", output_col="scores", batch_size=10,
@@ -324,7 +328,9 @@ def bench_stage_inference(jax, graph, variables) -> dict:
     )
     ref_ds = Dataset({"image": x[:ref_rows]})
     ref_stage.transform(ref_ds)  # warmup
-    ref_dt = min(_timed(lambda: ref_stage.transform(ref_ds)) for _ in range(3))
+    ref_dt = min(
+        _timed(lambda: ref_stage.transform(ref_ds)) for _ in range(trials)
+    )
     return {
         "stage_images_per_sec_per_chip": per_depth[best_depth],
         "stage_batch_size": batch,
@@ -335,6 +341,10 @@ def bench_stage_inference(jax, graph, variables) -> dict:
             ref_rows / ref_dt, 1
         ),
         "stage_refshape": "batch=10, serial feed (CNTKModel.scala:205)",
+        # the top-level 'timing' string describes the INFERENCE group;
+        # this group's trial count / row counts are its own methodology
+        "stage_trials": trials,
+        "stage_refshape_rows": ref_rows,
     }
 
 
@@ -673,13 +683,16 @@ def run(attempt: int) -> dict:
             shared["graph"], shared["vars"] = _flagship(jax, jnp)
         return shared["graph"], shared["vars"]
 
+    # ordered by value-per-second: the r4 run proved the tunnel can wedge
+    # MID-SWEEP, so the headline (inference), the MFU target (resnet50)
+    # and the kernel proof (flash) run before the slow stage sweep
     runners = {
         "inference": lambda: bench_inference(jax, jnp, *flagship()),
-        "stage": lambda: bench_stage_inference(jax, *flagship()),
         "resnet50": lambda: bench_resnet50(jax, jnp),
+        "flash": lambda: bench_flash(jax, jnp),
+        "stage": lambda: bench_stage_inference(jax, *flagship()),
         "train": lambda: bench_train_classifier(jax),
         "trees": lambda: bench_trees(jax),
-        "flash": lambda: bench_flash(jax, jnp),
     }
     # MMLTPU_BENCH_GROUPS=resnet50,inference runs a subset — lets a
     # short-lived healthy tunnel spend its minutes on the headline
