@@ -314,6 +314,19 @@ def bench_stage_inference(jax, graph, variables) -> dict:
         dt = min(_timed(lambda: stage.transform(ds)) for _ in range(trials))
         per_depth[depth] = round(n / dt / jax.device_count(), 1)
     best_depth = max(per_depth, key=per_depth.get)
+    # bf16 feed at the winning depth: the r4 run showed the stage is
+    # transfer-bound through the relay tunnel, so halving the bytes on
+    # the wire is the one lever that attacks the measured bottleneck
+    # directly (TPUModel.feed_dtype)
+    bf16_stage = TPUModel.from_graph(
+        graph, variables, "resnet20_cifar10",
+        input_col="image", output_col="scores", batch_size=batch,
+        feed_depth=best_depth, feed_dtype="bfloat16",
+    )
+    bf16_stage.transform(ds)  # warmup
+    bf16_dt = min(
+        _timed(lambda: bf16_stage.transform(ds)) for _ in range(trials)
+    )
     # reference-shaped comparison row: the reference's hot loop evaluates
     # 10-row minibatches strictly serially (JNI copy->evaluate->copy,
     # CNTKModel.scala:51-88, miniBatchSize default 10 at :205). Same
@@ -341,6 +354,9 @@ def bench_stage_inference(jax, graph, variables) -> dict:
             ref_rows / ref_dt, 1
         ),
         "stage_refshape": "batch=10, serial feed (CNTKModel.scala:205)",
+        "stage_bf16_feed_images_per_sec_per_chip": round(
+            n / bf16_dt / jax.device_count(), 1
+        ),
         # the top-level 'timing' string describes the INFERENCE group;
         # this group's trial count / row counts are its own methodology
         "stage_trials": trials,

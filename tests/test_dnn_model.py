@@ -53,6 +53,36 @@ def test_batch_invariance(mlp_model):
     np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-2)
 
 
+def test_bf16_feed_matches_f32(mlp_model):
+    """feed_dtype='bfloat16' halves host->HBM bytes; scores stay within
+    bf16 input-quantization tolerance of the f32 feed, padding included
+    (n=10 not divisible by batch 4)."""
+    ds = _feature_ds(n=10)
+    f32 = mlp_model.transform(ds)["scores"]
+    bf16 = mlp_model.copy().set(feed_dtype="bfloat16").transform(ds)["scores"]
+    assert bf16.shape == f32.shape
+    np.testing.assert_allclose(bf16, f32, rtol=3e-2, atol=3e-2)
+
+
+def test_bf16_feed_leaves_token_inputs_alone():
+    """Integer (token) columns must not be cast to bfloat16. The model is
+    an embedding lookup (transformer), so a wrongly-cast float index
+    batch raises inside jnp.take — the guard is regression-detectable,
+    not just shape-checked."""
+    cfg = {"vocab_size": 16, "d_model": 8, "heads": 2, "depth": 1,
+           "max_len": 3}
+    g = build_model("transformer_lm", **cfg)
+    v = g.init(jax.random.PRNGKey(0), jnp.zeros((1, 3), jnp.int32))
+    stage = TPUModel.from_graph(
+        g, v, "transformer_lm", model_config=cfg,
+        input_col="tokens", batch_size=4, feed_dtype="bfloat16",
+        data_parallel=False,
+    )
+    ds = Dataset({"tokens": np.arange(18).reshape(6, 3) % 16})  # int input
+    out = stage.transform(ds)
+    assert out["scores"].shape == (6, 3, 16)
+
+
 def test_output_node_cut(mlp_model):
     ds = _feature_ds(n=5)
     headless = mlp_model.copy().set(output_node="hidden1")
