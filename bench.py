@@ -232,6 +232,51 @@ def _chained_throughput(jax, jnp, graph, variables, x, iters, trials=3):
     return per_chip, flops_per_image
 
 
+def _chained_op_seconds(jax, jnp, step, q, k, v,
+                        n1=8, n2=40, trials=3):
+    """Per-iteration on-chip seconds for an attention-like op.
+
+    A single dispatch over the axon relay costs tens of ms of tunnel
+    latency — at flash-kernel scale that swamps the sub-ms on-chip time,
+    and even a single long chain leaves latency/len residue in the
+    per-iter figure. Timing two scan-chained programs of different
+    lengths and differencing, (t(n2) - t(n1)) / (n2 - n1), cancels every
+    fixed per-dispatch cost (tunnel round-trip, host fetch, dispatch)
+    exactly. The carry feeds each step's query so XLA cannot elide or
+    overlap iterations.
+
+    Returns ``(per_iter_seconds, used_fallback)``: when tunnel noise
+    makes the difference non-positive, falls back to t(n2)/n2 — which
+    retains ~latency/n2 of relay residue — and flags it so the emitted
+    artifact labels the method actually used, not the intended one.
+    (tools/flash_tpu_evidence.py imports this same helper for its
+    standalone artifact.)"""
+    one = jnp.asarray(1e-3, q.dtype)
+
+    def chain(n):
+        def run(q, k, v):
+            def body(carry, _):
+                out = step(carry, k, v)
+                return q + out.astype(q.dtype) * one, None
+
+            final, _ = jax.lax.scan(body, q, None, length=n)
+            return final.astype(jnp.float32).sum()
+
+        return jax.jit(run)
+
+    times = {}
+    for n in (n1, n2):
+        fn = chain(n)
+        np.asarray(fn(q, k, v))  # compile
+        times[n] = min(
+            _timed(lambda: np.asarray(fn(q, k, v))) for _ in range(trials)
+        )
+    per_iter = (times[n2] - times[n1]) / (n2 - n1)
+    if per_iter <= 0:  # tunnel noise exceeded the chained delta
+        return times[n2] / n2, True
+    return per_iter, False
+
+
 def bench_inference(jax, jnp, graph, variables) -> dict:
     """Images/sec/chip + MFU for ResNet-20 CIFAR inference. On TPU the
     batch size is swept (1024/4096) — the small 32x32 model leaves the
@@ -553,18 +598,47 @@ def bench_flash(jax, jnp) -> dict:
     want = np.asarray(ref(q, k, v))
     err = float(np.max(np.abs(out - want)))
 
-    t_flash = min(
-        _timed(lambda: np.asarray(flash(q, k, v).mean())) for _ in range(3)
-    )
-    t_xla = min(
-        _timed(lambda: np.asarray(ref(q, k, v).mean())) for _ in range(3)
-    )
+    if full:
+        # per-call walls over the axon relay time the tunnel (~50 ms),
+        # not the sub-ms kernel — use the dispatch-cancelling harness
+        t_flash, fb_flash = _chained_op_seconds(
+            jax, jnp,
+            lambda qq, k, v: flash_attention(qq, k, v, interpret=False),
+            q, k, v,
+        )
+        t_xla, fb_xla = _chained_op_seconds(
+            jax, jnp,
+            lambda qq, k, v: xla_attn(qq, k, v).astype(qq.dtype),
+            q, k, v,
+        )
+        timing = "scan-chained n1=8/n2=40 difference, best-of-3"
+        fallen = [n for n, fb in
+                  (("flash", fb_flash), ("xla", fb_xla)) if fb]
+        if fallen:
+            timing += (
+                f" (noisy delta for {'/'.join(fallen)}: fell back to "
+                "t(n2)/n2, which retains ~latency/n2 relay residue)"
+            )
+    else:
+        # CPU smoke has no dispatch latency to cancel, and chaining the
+        # INTERPRETER-mode kernel under lax.scan explodes compile time —
+        # per-call walls are both honest and cheap here
+        t_flash = min(
+            _timed(lambda: np.asarray(flash(q, k, v).mean()))
+            for _ in range(3)
+        )
+        t_xla = min(
+            _timed(lambda: np.asarray(ref(q, k, v).mean()))
+            for _ in range(3)
+        )
+        timing = "per-call best-of-3 (local backend, no relay latency)"
     return {
         "flash_fwd_ms": round(t_flash * 1e3, 3),
         "flash_xla_fwd_ms": round(t_xla * 1e3, 3),
         "flash_vs_xla_speedup": round(t_xla / t_flash, 3),
         "flash_max_abs_err": round(err, 5),
         "flash_shape": [b, s, h, d],
+        "flash_timing": timing,
         "flash_compiled": bool(full),  # False = interpreter-mode smoke
     }
 

@@ -70,12 +70,26 @@ def test_flash_tpu_evidence_artifact_contract():
         ev = json.load(f)
     assert ev["compiled"] is True and ev["interpret_mode"] is False
     assert "tpu" in ev["device_kind"].lower() or "v5" in ev["device_kind"]
+    # the gate is SCALE-NORMALIZED error (max abs err / max(1, max|want|)):
+    # both the kernel's bf16 output and the XLA reference's MXU matmuls
+    # carry precision relative to magnitude, and causal attention emits
+    # O(3) magnitudes in early rows — see _scaled_err in the tool.
     tol = ev["tolerance"]
     for mode in ("full", "causal"):
         n = ev["numerics"][mode]
-        assert n["fwd_max_abs_err"] <= tol
+        assert n["fwd_scaled_err"] <= tol
+        assert n["fwd_max_abs_err"] > 0  # recorded raw, not gated
         for key in ("dq", "dk", "dv"):
-            assert n[key] <= tol
-    assert ev["timing"], "block sweep missing"
-    for blk, t in ev["timing"].items():
+            assert n[f"{key}_scaled_err"] <= tol
+    blocks = {k: t for k, t in ev["timing"].items()
+              if k.startswith("block_")}
+    assert blocks, "block sweep missing"
+    for blk, t in blocks.items():
         assert t["fwd_ms"] > 0 and t["fwd_bwd_ms"] > 0, blk
+    # present only in artifacts recorded after the scan-chained timing
+    # harness landed (per-call walls over the axon relay measure tunnel
+    # latency, not the kernel; the chained harness amortizes it out)
+    if "xla_reference" in ev["timing"]:
+        assert ev["timing"]["xla_reference"]["fwd_ms"] > 0
+        for blk, t in blocks.items():
+            assert t["vs_xla_fwd_speedup"] > 0, blk
