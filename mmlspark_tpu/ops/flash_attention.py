@@ -4,8 +4,10 @@ The hot op the reference never had (no attention code exists in the
 reference tree — SURVEY.md §5): blockwise streaming-softmax attention that
 keeps the running (max, normalizer, accumulator) in VMEM scratch across the
 K-block grid dimension, so the (S, S) score matrix never hits HBM. Q/K/V
-tiles stream HBM→VMEM via the grid BlockSpecs; scores and the P·V matmul
-run on the MXU in float32 accumulation.
+tiles stream HBM→VMEM via the grid BlockSpecs; every matmul feeds the MXU
+native-dtype operands (bf16 in → f32 accumulate, the systolic array's fast
+path — upcasting operands first would force multi-pass f32 matmuls), with
+the softmax algebra kept in float32.
 
 Backward pass (FlashAttention-2 recipe): the forward additionally emits the
 per-row log-sum-exp (lanes-replicated, the same layout trick as the
@@ -46,8 +48,17 @@ def _round_up(n: int, m: int) -> int:
 # forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, blk: int, seq_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                scale: float, causal: bool, blk: int, seq_len: int,
+                with_lse: bool, masked: bool):
+    # the LSE residual exists only on the grad path (with_lse): the
+    # inference-only forward skips computing AND writing the
+    # lanes-replicated f32 (bh, s, 128) tensor, which would otherwise
+    # cost 4x the HBM write bytes of the bf16 output itself
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -62,21 +73,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU wants NATIVE-dtype operands with f32 accumulation: bf16 in,
+        # f32 out is the systolic array's fast path, while upcasting the
+        # operands first forces multi-pass f32 matmuls at a fraction of
+        # the throughput (f32 inputs still work — they just skip the cast)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (blk, blk)
-        kpos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        pad_mask = kpos >= seq_len  # padded keys never attend
-        if causal:
-            qpos = qi * blk + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
+        ) * scale  # (blk, blk) f32
+        if masked or causal:
+            kpos = ki * blk + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
             )
-            pad_mask = pad_mask | (kpos > qpos)
-        s = jnp.where(pad_mask, NEG_INF, s)
+            pad_mask = kpos >= seq_len  # padded keys never attend
+            if causal:
+                qpos = qi * blk + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0
+                )
+                pad_mask = pad_mask | (kpos > qpos)
+            s = jnp.where(pad_mask, NEG_INF, s)
 
         m_prev = m_scr[:, :1]  # (blk, 1), lanes replicated
         m_cur = s.max(axis=-1, keepdims=True)
@@ -85,7 +100,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -97,13 +112,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(
             o_ref.dtype
         )
-        # log-sum-exp residual for the backward; padded rows (l == 0)
-        # get NEG_INF so recomputed p vanishes there
-        lse_ref[0] = jnp.where(
-            l_scr[:] == 0.0,
-            NEG_INF,
-            m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])),
-        )
+        if with_lse:
+            # log-sum-exp residual for the backward; padded rows (l == 0)
+            # get NEG_INF so recomputed p vanishes there
+            lse_ref[0] = jnp.where(
+                l_scr[:] == 0.0,
+                NEG_INF,
+                m_scr[:] + jnp.log(
+                    jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+                ),
+            )
 
 
 def _to_bh(t, s_pad):
@@ -119,7 +137,7 @@ def _from_bh(t, b, h, s):
 
 
 def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
-                   interpret: bool):
+                   interpret: bool, with_lse: bool = True):
     b, s, h, d = q.shape
     blk = min(block, _round_up(s, 8))
     s_pad = _round_up(s, blk)
@@ -130,20 +148,24 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
                                    memory_space=pltpu.VMEM)
     lse_tile = pl.BlockSpec((1, blk, LANES), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
-    out, lse = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype)]
+    out_specs = [tile(lambda bh, i, j: (bh, i, 0))]
+    if with_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s_pad, LANES), jnp.float32)
+        )
+        out_specs.append(lse_tile)
+    res = pl.pallas_call(
         partial(_fwd_kernel, scale=scale, causal=causal, blk=blk,
-                seq_len=s),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_pad, LANES), jnp.float32),
-        ),
+                seq_len=s, with_lse=with_lse, masked=s_pad != s),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             tile(lambda bh, i, j: (bh, i, 0)),  # Q: row block
             tile(lambda bh, i, j: (bh, j, 0)),  # K: column block
             tile(lambda bh, i, j: (bh, j, 0)),  # V: column block
         ],
-        out_specs=(tile(lambda bh, i, j: (bh, i, 0)), lse_tile),
+        out_specs=tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((blk, LANES), jnp.float32),  # running max
             pltpu.VMEM((blk, LANES), jnp.float32),  # running normalizer
@@ -151,7 +173,10 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
         ],
         interpret=interpret,
     )(qb, kb, vb)
-    return _from_bh(out, b, h, s), lse
+    if with_lse:
+        out, lse = res
+        return _from_bh(out, b, h, s), lse
+    return _from_bh(res[0], b, h, s), None
 
 
 # ---------------------------------------------------------------------------
@@ -162,10 +187,8 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, scale, causal, blk,
                  seq_len):
     """Rebuild the (blk_q, blk_k) probability block from Q, K and the saved
     row log-sum-exp; masked/padded entries come back exactly zero."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
     lse = lse_ref[0][:, :1]  # (blk, 1), lanes replicated
@@ -195,23 +218,23 @@ def _bwd_kv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
     def _update():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, scale=scale,
                          causal=causal, blk=blk, seq_len=seq_len)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
+        # native-dtype MXU operands, f32 accumulation (see _fwd_kernel);
+        # p/ds are f32 from the softmax algebra and cast down to the
+        # input dtype for their matmuls, as the XLA reference path does
         # dV += Pᵀ · dO
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         # dS = P ⊙ (dO·Vᵀ − D)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - dd_ref[0][:, :1])
         # dK += dSᵀ · Q · scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
@@ -237,17 +260,14 @@ def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
     def _update():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, scale=scale,
                          causal=causal, blk=blk, seq_len=seq_len)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - dd_ref[0][:, :1])
-        # dQ += dS · K · scale
+        # dQ += dS · K · scale (native-dtype operands, f32 accumulation)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
@@ -336,9 +356,12 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
 def _build(causal: bool, scale_key, block: int, interpret: bool):
     @jax.custom_vjp
     def f(q, k, v):
+        # inference-only path: skip the LSE residual entirely (it is a
+        # grad-path artifact and 4x the output's HBM write bytes)
         scale = scale_key if scale_key else q.shape[-1] ** -0.5
         out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
-                                block=block, interpret=interpret)
+                                block=block, interpret=interpret,
+                                with_lse=False)
         return out
 
     def fwd(q, k, v):
@@ -367,6 +390,13 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None,
     elsewhere (tests). Sequences are padded to the block size internally;
     padded keys are masked, padded query rows are sliced away.
     """
+    if not (q.dtype == k.dtype == v.dtype):
+        # matmuls feed the MXU native-dtype operands (no f32 upcast),
+        # which requires a single dtype across the three inputs
+        raise ValueError(
+            "flash_attention requires q, k, v to share one dtype, got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}"
+        )
     if interpret is None:
         from mmlspark_tpu.core.env import is_tpu
 
