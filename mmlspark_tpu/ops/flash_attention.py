@@ -39,6 +39,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # finite: -inf minus -inf would poison the running max
 LANES = 128
 
+# all three kernels share a (batch·heads, outer-block, streamed-block)
+# grid: the first two dims own disjoint outputs/scratch, only the last
+# carries accumulator state across iterations
+_GRID_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY),
+)
+
 
 def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
@@ -155,6 +162,15 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
             jax.ShapeDtypeStruct((b * h, s_pad, LANES), jnp.float32)
         )
         out_specs.append(lse_tile)
+    # causal: K blocks above the diagonal (j > i) are fully masked — their
+    # compute is skipped via pl.when, and clamping the index map to the
+    # last LIVE block makes consecutive dead iterations re-reference the
+    # resident tile, so the pipeline skips their HBM→VMEM DMAs too
+    # (~halving causal K/V traffic)
+    if causal:
+        kv_im = lambda bh, i, j: (bh, jnp.minimum(i, j), 0)
+    else:
+        kv_im = lambda bh, i, j: (bh, j, 0)
     res = pl.pallas_call(
         partial(_fwd_kernel, scale=scale, causal=causal, blk=blk,
                 seq_len=s, with_lse=with_lse, masked=s_pad != s),
@@ -162,8 +178,8 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
         grid=grid,
         in_specs=[
             tile(lambda bh, i, j: (bh, i, 0)),  # Q: row block
-            tile(lambda bh, i, j: (bh, j, 0)),  # K: column block
-            tile(lambda bh, i, j: (bh, j, 0)),  # V: column block
+            tile(kv_im),                        # K: column block
+            tile(kv_im),                        # V: column block
         ],
         out_specs=tuple(out_specs),
         scratch_shapes=[
@@ -171,6 +187,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
             pltpu.VMEM((blk, LANES), jnp.float32),  # running normalizer
             pltpu.VMEM((blk, d), jnp.float32),      # accumulator
         ],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(qb, kb, vb)
     if with_lse:
@@ -297,6 +314,14 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
     rep = lambda im: pl.BlockSpec((1, blk, LANES), im,
                                   memory_space=pltpu.VMEM)
 
+    # causal dead blocks (see _flash_forward): clamp streamed-side index
+    # maps to the nearest live block so dead iterations skip their DMAs
+    if causal:
+        q_side_kv = lambda bh, j, i: (bh, jnp.maximum(i, j), 0)
+        kv_side_q = lambda bh, i, j: (bh, jnp.minimum(i, j), 0)
+    else:
+        q_side_kv = lambda bh, j, i: (bh, i, 0)
+        kv_side_q = lambda bh, i, j: (bh, j, 0)
     # dK / dV: fix the k block, stream q blocks (qi is the fastest grid dim)
     dkb, dvb = pl.pallas_call(
         partial(_bwd_kv_kernel, scale=scale, causal=causal, blk=blk,
@@ -307,10 +332,10 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
         ),
         grid=(b * h, n_blk, n_blk),
         in_specs=[
-            tile(lambda bh, j, i: (bh, i, 0)),  # Q
-            tile(lambda bh, j, i: (bh, i, 0)),  # dO
-            rep(lambda bh, j, i: (bh, i, 0)),   # LSE
-            rep(lambda bh, j, i: (bh, i, 0)),   # D
+            tile(q_side_kv),                    # Q
+            tile(q_side_kv),                    # dO
+            rep(q_side_kv),                     # LSE
+            rep(q_side_kv),                     # D
             tile(lambda bh, j, i: (bh, j, 0)),  # K
             tile(lambda bh, j, i: (bh, j, 0)),  # V
         ],
@@ -322,6 +347,7 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
             pltpu.VMEM((blk, d), jnp.float32),
             pltpu.VMEM((blk, d), jnp.float32),
         ],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(qb, dob, lse, dd, kb, vb)
 
@@ -332,8 +358,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
         out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
         grid=(b * h, n_blk, n_blk),
         in_specs=[
-            tile(lambda bh, i, j: (bh, j, 0)),  # K
-            tile(lambda bh, i, j: (bh, j, 0)),  # V
+            tile(kv_side_q),                    # K
+            tile(kv_side_q),                    # V
             tile(lambda bh, i, j: (bh, i, 0)),  # Q
             tile(lambda bh, i, j: (bh, i, 0)),  # dO
             rep(lambda bh, i, j: (bh, i, 0)),   # LSE
@@ -341,6 +367,7 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
         ],
         out_specs=tile(lambda bh, i, j: (bh, i, 0)),
         scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(kb, vb, qb, dob, lse, dd)
 
