@@ -18,7 +18,12 @@ OUTSIDE interpreter mode on the chip:
    fixed per-dispatch tunnel latency (~50 ms, vs a sub-ms kernel)
    exactly — plus an identically-harnessed XLA attention for an
    on-chip speedup ratio,
-4. writes ``FLASH_TPU_EVIDENCE.json`` at the repo root for committing.
+4. times a long-context leg at S=8192 (``timing.long_context_s8192``):
+   the fused kernel stays O(S·d) in VMEM while the XLA path pushes a
+   ~2.1 GB (S, S) f32 score tensor through HBM each step — the regime
+   the kernel exists for; the flash number is recorded even if the XLA
+   side OOMs (that failure being evidence itself),
+5. writes ``FLASH_TPU_EVIDENCE.json`` at the repo root for committing.
 
 A wedged tunnel is detected with a killable subprocess probe first, so
 the script fails fast with exit 2 instead of hanging.
@@ -228,6 +233,61 @@ def main() -> None:
         print(f"block {blk}: fwd {t_f*1e3:.2f} ms "
               f"({attn_flops_fwd / t_f / 1e12:.1f} TFLOP/s, "
               f"{t_xla / t_f:.2f}x XLA), fwd+bwd {t_fb*1e3:.2f} ms")
+
+    # -- long-context leg: the regime the kernel exists for ---------------
+    # at S=8192 the XLA path materializes an (S, S) f32 score tensor
+    # (~2.1 GB at B=1, H=8) through HBM every step, while the fused
+    # kernel stays O(S·d) in VMEM — this is where fusion must WIN, not
+    # just match. Timed under the identical chained harness; guarded so
+    # an OOM or compile failure cannot cost the rest of the artifact.
+    try:
+        SL = 8192
+        blk_best = min(
+            BLOCKS,
+            key=lambda b: evidence["timing"][f"block_{b}"]["fwd_ms"],
+        )
+        ql, kl, vl = (
+            jnp.asarray(rng.normal(size=(1, SL, H, D)), jnp.bfloat16)
+            for _ in range(3)
+        )
+        flops_l = 4 * 1 * H * SL * SL * D
+
+        def _long(step):
+            return _chained_op_seconds(jax, jnp, step, ql, kl, vl)
+
+        t_lf, fb_lf = _long(
+            lambda qq, k, v: flash_attention(
+                qq, k, v, block=blk_best, interpret=False)
+        )
+        # record flash IMMEDIATELY: if the XLA side then OOMs on its
+        # ~2.1 GB score tensor, that failure is itself the strongest
+        # evidence for the fused kernel and must not erase this number
+        long_ev = {
+            "block": blk_best,
+            "flash_fwd_ms": round(t_lf * 1e3, 3),
+            "flash_tflops_per_s": round(flops_l / t_lf / 1e12, 2),
+            "noise_fallback_t_over_n": fb_lf,
+        }
+        evidence["timing"]["long_context_s8192"] = long_ev
+        print(f"long-context S={SL}: flash {t_lf*1e3:.2f} ms "
+              f"({flops_l / t_lf / 1e12:.1f} TFLOP/s)")
+        try:
+            t_lx, fb_lx = _long(lambda qq, k, v: xla_step(qq, k, v))
+            long_ev.update(
+                xla_fwd_ms=round(t_lx * 1e3, 3),
+                vs_xla_fwd_speedup=round(t_lx / t_lf, 3),
+                noise_fallback_t_over_n=fb_lf or fb_lx,
+            )
+            print(f"  xla {t_lx*1e3:.2f} ms -> {t_lx/t_lf:.2f}x")
+        except Exception as e:  # noqa: BLE001
+            long_ev["xla_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            print("  xla side failed (flash number kept):",
+                  type(e).__name__, str(e)[:120])
+    except Exception as e:  # noqa: BLE001 — leg is additive evidence
+        evidence["timing"]["long_context_s8192"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
+        print("long-context leg failed:", type(e).__name__, str(e)[:120])
 
     evidence["timing"]["method"] = (
         "difference of two lax.scan-chained runs (n1=8, n2=40) inside "
