@@ -97,3 +97,29 @@ def test_scratch_merge_roundtrip_and_missing_groups(monkeypatch, tmp_path):
     # merge is a real file round-trip: a fresh load sees the update
     with open(os.environ["MMLTPU_BENCH_SCRATCH"], encoding="utf-8") as f:
         assert json.load(f)["mfu"] == 0.1
+
+
+def test_chained_op_seconds_contract(monkeypatch, tmp_path):
+    """The dispatch-cancelling timing harness (shared with
+    tools/flash_tpu_evidence.py) returns positive per-iteration seconds
+    plus a fallback flag, and traces the step per chain — not per
+    iteration (the chained iterations live inside one lax.scan)."""
+    bench = _bench(monkeypatch, tmp_path)
+    import jax
+    import jax.numpy as jnp
+
+    q = jnp.ones((1, 8, 1, 4), jnp.float32)
+    k = v = q
+    calls = []
+
+    def step(qq, k, v):
+        calls.append(1)
+        return qq * 2.0
+
+    secs, fell_back = bench._chained_op_seconds(
+        jax, jnp, step, q, k, v, n1=2, n2=4, trials=1
+    )
+    assert secs > 0 and isinstance(fell_back, bool)
+    # per chain (2 chains), never per iteration (n1 + n2 = 6); exact
+    # trace counts are JAX-internal, so only the upper bound is pinned
+    assert len(calls) < 6
