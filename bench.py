@@ -601,15 +601,17 @@ def bench_flash(jax, jnp) -> dict:
     if full:
         # per-call walls over the axon relay time the tunnel (~50 ms),
         # not the sub-ms kernel — use the dispatch-cancelling harness
+        flash_step = lambda qq, k, v: flash_attention(  # noqa: E731
+            qq, k, v, interpret=False
+        )
+        xla_step = lambda qq, k, v: xla_attn(  # noqa: E731
+            qq, k, v
+        ).astype(qq.dtype)
         t_flash, fb_flash = _chained_op_seconds(
-            jax, jnp,
-            lambda qq, k, v: flash_attention(qq, k, v, interpret=False),
-            q, k, v,
+            jax, jnp, flash_step, q, k, v,
         )
         t_xla, fb_xla = _chained_op_seconds(
-            jax, jnp,
-            lambda qq, k, v: xla_attn(qq, k, v).astype(qq.dtype),
-            q, k, v,
+            jax, jnp, xla_step, q, k, v,
         )
         timing = "scan-chained n1=8/n2=40 difference, best-of-3"
         fallen = [n for n, fb in
@@ -632,7 +634,7 @@ def bench_flash(jax, jnp) -> dict:
             for _ in range(3)
         )
         timing = "per-call best-of-3 (local backend, no relay latency)"
-    return {
+    res = {
         "flash_fwd_ms": round(t_flash * 1e3, 3),
         "flash_xla_fwd_ms": round(t_xla * 1e3, 3),
         "flash_vs_xla_speedup": round(t_xla / t_flash, 3),
@@ -641,6 +643,41 @@ def bench_flash(jax, jnp) -> dict:
         "flash_timing": timing,
         "flash_compiled": bool(full),  # False = interpreter-mode smoke
     }
+    if full:
+        # long-context leg: at S=8192 the XLA path streams a ~2.1 GB
+        # (S, S) f32 score tensor through HBM per step while the fused
+        # kernel stays O(S·d) in VMEM — the regime the kernel exists
+        # for, recorded in the driver's own artifact. Flash lands first
+        # so an XLA-side OOM (itself evidence for fusion) can't erase it.
+        try:
+            sl = 8192
+            ql, kl, vl = (
+                jnp.asarray(rng.normal(size=(1, sl, h, d)), jnp.bfloat16)
+                for _ in range(3)
+            )
+            t_lf, fb_lf = _chained_op_seconds(
+                jax, jnp, flash_step, ql, kl, vl,
+            )
+            res["flash_long_s8192_fwd_ms"] = round(t_lf * 1e3, 3)
+            res["flash_long_s8192_noise_fallback"] = fb_lf
+            try:
+                t_lx, fb_lx = _chained_op_seconds(
+                    jax, jnp, xla_step, ql, kl, vl,
+                )
+                res["flash_long_s8192_xla_fwd_ms"] = round(t_lx * 1e3, 3)
+                res["flash_long_s8192_vs_xla_speedup"] = round(
+                    t_lx / t_lf, 3
+                )
+                res["flash_long_s8192_noise_fallback"] = fb_lf or fb_lx
+            except Exception as e:  # noqa: BLE001
+                res["flash_long_s8192_xla_error"] = (
+                    f"{type(e).__name__}: {str(e)[:160]}"
+                )
+        except Exception as e:  # noqa: BLE001 — leg is additive
+            res["flash_long_s8192_error"] = (
+                f"{type(e).__name__}: {str(e)[:160]}"
+            )
+    return res
 
 
 # --------------------------------------------------------------------------
