@@ -938,6 +938,30 @@ def _final_line(results: dict, attempt: int, error: str | None = None) -> dict:
     if line.get("value") is not None and primary_backend != "tpu":
         line["images_per_sec_per_chip"] = line["value"]
         line["value"] = None
+    # the reference publishes no numbers (BASELINE.md), so the only
+    # honest baseline is this repo's own committed in-session record:
+    # ratio vs the newest BENCH_LOCAL_r*.json headline, labeled by
+    # source. Runs AFTER the provenance guard above, so only a
+    # TPU-measured headline is ever compared against the TPU record,
+    # and a decorative lookup failure can never kill emission.
+    if line.get("value") is not None:
+        try:
+            base = os.path.dirname(os.path.abspath(__file__))
+            locals_ = sorted(
+                (f for f in os.listdir(base)
+                 if f.startswith("BENCH_LOCAL_r") and f.endswith(".json")),
+                key=lambda f: int(f[len("BENCH_LOCAL_r"):-len(".json")]),
+            )
+            with open(os.path.join(base, locals_[-1]),
+                      encoding="utf-8") as f:
+                prior = json.load(f).get("value")
+            line["vs_baseline"] = round(line["value"] / float(prior), 4)
+            line["vs_baseline_source"] = (
+                f"{locals_[-1]} (own committed record; reference "
+                "publishes no numbers)"
+            )
+        except Exception:  # noqa: BLE001 — never risk the emission path
+            pass
     if _cpu_smoke_mode():
         # ``error_class`` is NOT forced here: the generic classifier above
         # already labels tunnel-shaped reasons unreachable, and a genuine
