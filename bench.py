@@ -810,16 +810,20 @@ def run(attempt: int) -> dict:
             shared["graph"], shared["vars"] = _flagship(jax, jnp)
         return shared["graph"], shared["vars"]
 
-    # ordered by value-per-second: the r4 run proved the tunnel can wedge
-    # MID-SWEEP, so the headline (inference), the MFU target (resnet50)
-    # and the kernel proof (flash) run before the slow stage sweep
+    # value-per-second order (the r4 run proved the tunnel can wedge
+    # MID-SWEEP, so the headline and MFU target go first), refined by
+    # measured r4 group walls: the
+    # cheap train/trees groups (~25 s on TPU combined) run BEFORE flash —
+    # the flash group's chained compiles over the relay are the likeliest
+    # to hang a wedging tunnel, and must not starve the cheap groups —
+    # and the slow stage sweep stays last
     runners = {
         "inference": lambda: bench_inference(jax, jnp, *flagship()),
         "resnet50": lambda: bench_resnet50(jax, jnp),
-        "flash": lambda: bench_flash(jax, jnp),
-        "stage": lambda: bench_stage_inference(jax, *flagship()),
         "train": lambda: bench_train_classifier(jax),
         "trees": lambda: bench_trees(jax),
+        "flash": lambda: bench_flash(jax, jnp),
+        "stage": lambda: bench_stage_inference(jax, *flagship()),
     }
     # MMLTPU_BENCH_GROUPS=resnet50,inference runs a subset — lets a
     # short-lived healthy tunnel spend its minutes on the headline
