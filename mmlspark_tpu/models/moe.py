@@ -93,8 +93,9 @@ class MoEBlock(nn.Module):
     def __call__(self, x, mask=None):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(self.heads, self.head_dim, self.causal,
-                              resolve_attn_impl(self.attn_impl), None,
-                              self.dtype, name="attn")(y)
+                              resolve_attn_impl(self.attn_impl),
+                              mesh=None, dtype=self.dtype,
+                              name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = MoEFFN(self.n_experts, self.d_ff, self.capacity_factor,
                    self.dtype, name="moe")(y, mask)

@@ -70,6 +70,7 @@ class SelfAttention(nn.Module):
     head_dim: int
     causal: bool
     attn_impl: str = DENSE
+    window: int | None = None  # causal sliding window (flash impl only)
     mesh: Any = None  # jax.sharding.Mesh (hashable -> valid static attr)
     dtype: Any = jnp.bfloat16
 
@@ -86,10 +87,17 @@ class SelfAttention(nn.Module):
                 f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
             )
         impl = resolve_attn_impl(self.attn_impl)
+        if self.window is not None and impl != FLASH:
+            raise ParamError(
+                "window (sliding-window attention) is implemented by the "
+                f"flash kernel; attn_impl='{self.attn_impl}' resolved to "
+                f"'{impl}'"
+            )
         if impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 
-            o = flash_attention(q, k, v, causal=self.causal)
+            o = flash_attention(q, k, v, causal=self.causal,
+                                window=self.window)
         elif impl == DENSE or self.mesh is None:
             # ring/ulysses degrade to dense when no mesh is provided
             o = dense_attention(q, k, v, causal=self.causal)
@@ -119,13 +127,15 @@ class Block(nn.Module):
     attn_impl: str
     mesh: Any
     dtype: Any = jnp.bfloat16
+    window: int | None = None
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
-            self.mesh, self.dtype, name="attn",
+            window=self.window, mesh=self.mesh, dtype=self.dtype,
+            name="attn",
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = nn.Dense(self.d_ff, dtype=self.dtype, param_dtype=jnp.float32,
@@ -158,13 +168,19 @@ def transformer_lm(
     max_len: int = 512,
     causal: bool = True,
     attn_impl: str = AUTO,
+    window: int | None = None,
     mesh: Any = None,
 ) -> NamedGraph:
     """Decoder-only LM (or bidirectional encoder with ``causal=False``);
     per-token logits, so it also serves as the long-context sequence
-    tagger (the BiLSTM capability, scaled)."""
+    tagger (the BiLSTM capability, scaled). ``window=W`` enables the
+    flash kernel's causal sliding window (O(S·W) attention work)."""
     if d_model % heads:
         raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
+    if window is not None and not causal:
+        raise ParamError(
+            "window (causal sliding-window attention) requires causal=True"
+        )
     if attn_impl not in ATTN_IMPLS:
         raise ParamError(
             f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
@@ -179,7 +195,7 @@ def transformer_lm(
             (
                 f"block{i}",
                 Block(heads, d_model // heads, d_ff, causal, attn_impl,
-                      mesh),
+                      mesh, window=window),
             )
         )
     blocks.append((FINAL_NODE, LMHead(vocab_size)))
@@ -192,5 +208,6 @@ def transformer_lm(
             "attn_impl": attn_impl,
             "causal": causal,
             "heads": heads,
+            "window": window,
         },
     )

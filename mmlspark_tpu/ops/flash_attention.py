@@ -52,12 +52,65 @@ def _round_up(n: int, m: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# masking geometry, shared by the forward kernel, both backward kernels,
+# and the dead-block index-map clamps — one definition of which (query,
+# key) pairs attend, in three granularities:
+#   _block_live    — does K block ki intersect Q block qi's span at all?
+#   _dead_mask     — per-element mask inside a (blk, blk) score tile
+#   _live_k_range  — [lo, hi] of live K blocks for Q block qi (clamps)
+
+
+def _block_live(qi, ki, *, causal: bool, window: int | None, blk: int):
+    live = True
+    if causal:
+        live = ki * blk <= qi * blk + blk - 1
+    if window is not None:
+        # the OLDEST query row in block qi (pos qi*blk) attends the
+        # block's oldest keys, >= qi*blk - window + 1; a K block whose
+        # last position is older than even that is fully outside the
+        # window for every row in the block
+        live = live & (ki * blk + blk - 1 >= qi * blk - window + 1)
+    return live
+
+
+def _dead_mask(qi, ki, shape, *, causal: bool, window: int | None,
+               seq_len: int, blk: int, with_q_pad: bool = False):
+    """Boolean (blk, blk) mask of entries that must NOT attend (always
+    includes the padded-key mask; callers skip the call entirely on the
+    pad-free non-causal no-window path)."""
+    need_q = causal or window is not None or with_q_pad
+    kpos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    dead = kpos >= seq_len  # padded keys never attend
+    if need_q:
+        qpos = qi * blk + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        if with_q_pad:
+            dead = dead | (qpos >= seq_len)
+        if causal:
+            dead = dead | (kpos > qpos)
+        if window is not None:
+            dead = dead | (kpos <= qpos - window)
+    return dead
+
+
+def _live_k_range(qi, *, window: int | None, blk: int):
+    """[lo, hi_unbounded) of K blocks live for Q block qi under causal
+    (+ optional window) masking; used to clamp streamed-side index maps
+    so dead iterations re-reference a resident tile (no DMA)."""
+    hi = qi  # causal: nothing right of the diagonal block
+    if window is None:
+        lo = jnp.zeros_like(qi)
+    else:
+        lo = jnp.maximum(0, (qi * blk - window + 1) // blk)
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
 # forward
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-                scale: float, causal: bool, blk: int, seq_len: int,
-                with_lse: bool, masked: bool):
+                scale: float, causal: bool, window: int | None, blk: int,
+                seq_len: int, with_lse: bool, masked: bool):
     # the LSE residual exists only on the grad path (with_lse): the
     # inference-only forward skips computing AND writing the
     # lanes-replicated f32 (bh, s, 128) tensor, which would otherwise
@@ -75,8 +128,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: K blocks fully above the diagonal contribute nothing
-    live = (ki * blk <= qi * blk + blk - 1) if causal else True
+    live = _block_live(qi, ki, causal=causal, window=window, blk=blk)
 
     @pl.when(live)
     def _update():
@@ -88,17 +140,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (blk, blk) f32
-        if masked or causal:
-            kpos = ki * blk + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1
+        if masked or causal or window is not None:
+            s = jnp.where(
+                _dead_mask(qi, ki, s.shape, causal=causal, window=window,
+                           seq_len=seq_len, blk=blk),
+                NEG_INF, s,
             )
-            pad_mask = kpos >= seq_len  # padded keys never attend
-            if causal:
-                qpos = qi * blk + jax.lax.broadcasted_iota(
-                    jnp.int32, s.shape, 0
-                )
-                pad_mask = pad_mask | (kpos > qpos)
-            s = jnp.where(pad_mask, NEG_INF, s)
 
         m_prev = m_scr[:, :1]  # (blk, 1), lanes replicated
         m_cur = s.max(axis=-1, keepdims=True)
@@ -143,8 +190,9 @@ def _from_bh(t, b, h, s):
     return jnp.moveaxis(t[:, :s].reshape(b, h, s, -1), 1, 2)
 
 
-def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
-                   interpret: bool, with_lse: bool = True):
+def _flash_forward(q, k, v, *, causal: bool, window: int | None,
+                   scale: float, block: int, interpret: bool,
+                   with_lse: bool = True):
     b, s, h, d = q.shape
     blk = min(block, _round_up(s, 8))
     s_pad = _round_up(s, blk)
@@ -168,12 +216,15 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
     # resident tile, so the pipeline skips their HBM→VMEM DMAs too
     # (~halving causal K/V traffic)
     if causal:
-        kv_im = lambda bh, i, j: (bh, jnp.minimum(i, j), 0)
+        def kv_im(bh, i, j):
+            lo, hi = _live_k_range(i, window=window, blk=blk)
+            return (bh, jnp.clip(j, lo, hi), 0)
     else:
-        kv_im = lambda bh, i, j: (bh, j, 0)
+        kv_im = lambda bh, i, j: (bh, j, 0)  # noqa: E731
     res = pl.pallas_call(
-        partial(_fwd_kernel, scale=scale, causal=causal, blk=blk,
-                seq_len=s, with_lse=with_lse, masked=s_pad != s),
+        partial(_fwd_kernel, scale=scale, causal=causal, window=window,
+                blk=blk, seq_len=s, with_lse=with_lse,
+                masked=s_pad != s),
         out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
@@ -200,8 +251,8 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block: int,
 # backward
 
 
-def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, scale, causal, blk,
-                 seq_len):
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, scale, causal, window,
+                 blk, seq_len):
     """Rebuild the (blk_q, blk_k) probability block from Q, K and the saved
     row log-sum-exp; masked/padded entries come back exactly zero."""
     s = jax.lax.dot_general(
@@ -210,17 +261,15 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, scale, causal, blk,
     ) * scale
     lse = lse_ref[0][:, :1]  # (blk, 1), lanes replicated
     p = jnp.exp(s - lse)
-    kpos = ki * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    qpos = qi * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    dead = (kpos >= seq_len) | (qpos >= seq_len)
-    if causal:
-        dead = dead | (kpos > qpos)
+    dead = _dead_mask(qi, ki, s.shape, causal=causal, window=window,
+                      seq_len=seq_len, blk=blk, with_q_pad=True)
     return jnp.where(dead, 0.0, p)
 
 
 def _bwd_kv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
                    dk_ref, dv_ref, dk_scr, dv_scr, *,
-                   scale: float, causal: bool, blk: int, seq_len: int):
+                   scale: float, causal: bool, window: int | None,
+                   blk: int, seq_len: int):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -229,12 +278,13 @@ def _bwd_kv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = (kj * blk <= qi * blk + blk - 1) if causal else True
+    live = _block_live(qi, kj, causal=causal, window=window, blk=blk)
 
     @pl.when(live)
     def _update():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, scale=scale,
-                         causal=causal, blk=blk, seq_len=seq_len)
+                         causal=causal, window=window, blk=blk,
+                         seq_len=seq_len)
         # native-dtype MXU operands, f32 accumulation (see _fwd_kernel);
         # p/ds are f32 from the softmax algebra and cast down to the
         # input dtype for their matmuls, as the XLA reference path does
@@ -263,7 +313,8 @@ def _bwd_kv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
 
 def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
                   dq_ref, dq_scr, *,
-                  scale: float, causal: bool, blk: int, seq_len: int):
+                  scale: float, causal: bool, window: int | None,
+                  blk: int, seq_len: int):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -271,12 +322,13 @@ def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (kj * blk <= qi * blk + blk - 1) if causal else True
+    live = _block_live(qi, kj, causal=causal, window=window, blk=blk)
 
     @pl.when(live)
     def _update():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, scale=scale,
-                         causal=causal, blk=blk, seq_len=seq_len)
+                         causal=causal, window=window, blk=blk,
+                         seq_len=seq_len)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -293,8 +345,9 @@ def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
-                    block: int, interpret: bool):
+def _flash_backward(q, k, v, out, lse, g, *, causal: bool,
+                    window: int | None, scale: float, block: int,
+                    interpret: bool):
     b, s, h, d = q.shape
     blk = min(block, _round_up(s, 8))
     s_pad = _round_up(s, blk)
@@ -317,15 +370,24 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
     # causal dead blocks (see _flash_forward): clamp streamed-side index
     # maps to the nearest live block so dead iterations skip their DMAs
     if causal:
-        q_side_kv = lambda bh, j, i: (bh, jnp.maximum(i, j), 0)
-        kv_side_q = lambda bh, i, j: (bh, jnp.minimum(i, j), 0)
+        def q_side_kv(bh, j, i):
+            # live q blocks for K block j: i in [j, hi] (hi bounded by
+            # the window: the newest query that still sees block j)
+            if window is None:
+                return (bh, jnp.maximum(i, j), 0)
+            hi = (j * blk + blk + window - 2) // blk
+            return (bh, jnp.clip(i, j, hi), 0)
+
+        def kv_side_q(bh, i, j):
+            lo, hi = _live_k_range(i, window=window, blk=blk)
+            return (bh, jnp.clip(j, lo, hi), 0)
     else:
-        q_side_kv = lambda bh, j, i: (bh, i, 0)
-        kv_side_q = lambda bh, i, j: (bh, j, 0)
+        q_side_kv = lambda bh, j, i: (bh, i, 0)  # noqa: E731
+        kv_side_q = lambda bh, i, j: (bh, j, 0)  # noqa: E731
     # dK / dV: fix the k block, stream q blocks (qi is the fastest grid dim)
     dkb, dvb = pl.pallas_call(
-        partial(_bwd_kv_kernel, scale=scale, causal=causal, blk=blk,
-                seq_len=s),
+        partial(_bwd_kv_kernel, scale=scale, causal=causal,
+                window=window, blk=blk, seq_len=s),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
@@ -353,8 +415,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
 
     # dQ: fix the q block, stream k blocks (kj fastest)
     dqb = pl.pallas_call(
-        partial(_bwd_q_kernel, scale=scale, causal=causal, blk=blk,
-                seq_len=s),
+        partial(_bwd_q_kernel, scale=scale, causal=causal,
+                window=window, blk=blk, seq_len=s),
         out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
         grid=(b * h, n_blk, n_blk),
         in_specs=[
@@ -380,38 +442,48 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
 
 
 @lru_cache(maxsize=None)
-def _build(causal: bool, scale_key, block: int, interpret: bool):
+def _build(causal: bool, window: int | None, scale_key, block: int,
+           interpret: bool):
     @jax.custom_vjp
     def f(q, k, v):
         # inference-only path: skip the LSE residual entirely (it is a
         # grad-path artifact and 4x the output's HBM write bytes)
         scale = scale_key if scale_key else q.shape[-1] ** -0.5
-        out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
-                                block=block, interpret=interpret,
-                                with_lse=False)
+        out, _ = _flash_forward(q, k, v, causal=causal, window=window,
+                                scale=scale, block=block,
+                                interpret=interpret, with_lse=False)
         return out
 
     def fwd(q, k, v):
         scale = scale_key if scale_key else q.shape[-1] ** -0.5
-        out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
-                                  block=block, interpret=interpret)
+        out, lse = _flash_forward(q, k, v, causal=causal, window=window,
+                                  scale=scale, block=block,
+                                  interpret=interpret)
         return out, (q, k, v, out, lse)
 
     def bwd(res, g):
         q, k, v, out, lse = res
         scale = scale_key if scale_key else q.shape[-1] ** -0.5
         return _flash_backward(q, k, v, out, lse, g, causal=causal,
-                               scale=scale, block=block,
+                               window=window, scale=scale, block=block,
                                interpret=interpret)
 
     f.defvjp(fwd, bwd)
     return f
 
 
-def flash_attention(q, k, v, *, causal: bool = False, scale=None,
+def flash_attention(q, k, v, *, causal: bool = False,
+                    window: int | None = None, scale=None,
                     block: int = 128, interpret: bool | None = None):
     """Blockwise fused attention, (B, S, H, D) layout, exact output AND
     exact gradients — both directions O(S·d) memory.
+
+    ``window=W`` restricts each query to the W most recent keys
+    (positions ``qpos - W + 1 .. qpos``, Mistral-style sliding window;
+    requires ``causal=True``). Work AND streamed HBM traffic then scale
+    O(S·W) instead of O(S²): blocks outside the band are skipped by the
+    same dead-block machinery as causal masking, on both window edges,
+    in forward and both backward kernels.
 
     ``interpret=None`` auto-selects: compiled kernel on TPU, interpreter
     elsewhere (tests). Sequences are padded to the block size internally;
@@ -424,8 +496,17 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None,
             "flash_attention requires q, k, v to share one dtype, got "
             f"{q.dtype}/{k.dtype}/{v.dtype}"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "flash_attention window=W is the causal sliding window; "
+                "pass causal=True with it"
+            )
+        if int(window) < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        window = int(window)
     if interpret is None:
         from mmlspark_tpu.core.env import is_tpu
 
         interpret = not is_tpu()
-    return _build(causal, scale, block, bool(interpret))(q, k, v)
+    return _build(causal, window, scale, block, bool(interpret))(q, k, v)

@@ -122,3 +122,106 @@ def test_flash_gradients_multiblock(rng):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
         )
+
+
+def _dense_window_ref(q, k, v, window):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S = q.shape[1]
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d ** -0.5)
+    qpos = np.arange(S)[:, None]
+    kpos = np.arange(S)[None, :]
+    keep = (kpos <= qpos) & (kpos > qpos - window)
+    s = jnp.where(keep[None, None], s, -1e30)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("S,block,window", [
+    (64, 16, 16),   # window == block
+    (64, 16, 24),   # window spans block boundary
+    (40, 16, 7),    # window < block, padded sequence
+    (96, 32, 96),   # window == full length (degenerates to causal)
+])
+def test_sliding_window_forward_matches_dense(S, block, window):
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, S, 2, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    got = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=window, block=block
+        )
+    )(q, k, v)
+    want = _dense_window_ref(q, k, v, window)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_sliding_window_grads_match_dense():
+    S, block, window = 48, 16, 20
+    rng = np.random.default_rng(6)
+    q, k, v, g = (
+        jnp.asarray(rng.normal(size=(1, S, 2, 16)), jnp.float32)
+        for _ in range(4)
+    )
+    gf = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, window=window, block=block) * g),
+        argnums=(0, 1, 2),
+    ))(q, k, v)
+    gr = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(_dense_window_ref(q, k, v, window) * g),
+        argnums=(0, 1, 2),
+    ))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_window_requires_causal_and_positive():
+    q = jnp.ones((1, 8, 1, 4), jnp.float32)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, window=4)
+    with pytest.raises(ValueError, match=">= 1"):
+        flash_attention(q, q, q, causal=True, window=0)
+
+
+def test_transformer_lm_sliding_window():
+    """window plumbs from the model builder through the flash kernel and
+    changes the function (a token outside the window stops influencing
+    the current position's logits)."""
+    from mmlspark_tpu.models.registry import build_model
+
+    m = build_model("transformer_lm", vocab_size=32, d_model=16, heads=2,
+                    depth=1, max_len=24, attn_impl="flash", window=4)
+    assert m.extra["window"] == 4
+    x = jnp.asarray(np.arange(24)[None] % 32, jnp.int32)
+    vars_ = m.init(jax.random.PRNGKey(0), x)
+    base = np.asarray(jax.jit(m.apply)(vars_, x))
+    # perturb a token 8 positions back: outside window=4 for the last pos
+    x2 = np.array(x)
+    x2[0, 24 - 9] = (x2[0, 24 - 9] + 1) % 32
+    out2 = np.asarray(jax.jit(m.apply)(vars_, jnp.asarray(x2)))
+    assert np.allclose(base[0, -1], out2[0, -1], atol=1e-5)
+    # ...but inside the window it does influence
+    x3 = np.array(x)
+    x3[0, 24 - 2] = (x3[0, 24 - 2] + 1) % 32
+    out3 = np.asarray(jax.jit(m.apply)(vars_, jnp.asarray(x3)))
+    assert not np.allclose(base[0, -1], out3[0, -1], atol=1e-5)
+
+
+def test_window_rejected_for_non_flash_impl():
+    from mmlspark_tpu.core.exceptions import ParamError
+    from mmlspark_tpu.models.registry import build_model
+
+    m = build_model("transformer_lm", vocab_size=32, d_model=16, heads=2,
+                    depth=1, max_len=16, attn_impl="dense", window=4)
+    x = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ParamError, match="flash"):
+        m.init(jax.random.PRNGKey(0), x)
