@@ -70,7 +70,7 @@ class SelfAttention(nn.Module):
     head_dim: int
     causal: bool
     attn_impl: str = DENSE
-    window: int | None = None  # causal sliding window (flash impl only)
+    window: int | None = None  # causal sliding window (all impls)
     mesh: Any = None  # jax.sharding.Mesh (hashable -> valid static attr)
     dtype: Any = jnp.bfloat16
 
@@ -87,12 +87,6 @@ class SelfAttention(nn.Module):
                 f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
             )
         impl = resolve_attn_impl(self.attn_impl)
-        if self.window is not None and impl != FLASH:
-            raise ParamError(
-                "window (sliding-window attention) is implemented by the "
-                f"flash kernel; attn_impl='{self.attn_impl}' resolved to "
-                f"'{impl}'"
-            )
         if impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 
@@ -100,17 +94,20 @@ class SelfAttention(nn.Module):
                                 window=self.window)
         elif impl == DENSE or self.mesh is None:
             # ring/ulysses degrade to dense when no mesh is provided
-            o = dense_attention(q, k, v, causal=self.causal)
+            o = dense_attention(q, k, v, causal=self.causal,
+                                window=self.window)
         elif impl == RING:
             from mmlspark_tpu.parallel.context_parallel import ring_attention
 
-            o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+            o = ring_attention(q, k, v, self.mesh, causal=self.causal,
+                               window=self.window)
         elif impl == ULYSSES:
             from mmlspark_tpu.parallel.context_parallel import (
                 ulysses_attention,
             )
 
-            o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)
+            o = ulysses_attention(q, k, v, self.mesh, causal=self.causal,
+                                  window=self.window)
         else:  # unreachable: impl validated + resolved above
             raise ParamError(f"unhandled attn_impl '{impl}'")
         return nn.Dense(x.shape[-1], dtype=self.dtype,
@@ -177,10 +174,14 @@ def transformer_lm(
     flash kernel's causal sliding window (O(S·W) attention work)."""
     if d_model % heads:
         raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
-    if window is not None and not causal:
-        raise ParamError(
-            "window (causal sliding-window attention) requires causal=True"
-        )
+    if window is not None:
+        if not causal:
+            raise ParamError(
+                "window (causal sliding-window attention) requires "
+                "causal=True"
+            )
+        if int(window) < 1:
+            raise ParamError(f"window must be >= 1, got {window}")
     if attn_impl not in ATTN_IMPLS:
         raise ParamError(
             f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"

@@ -20,16 +20,22 @@ import jax.numpy as jnp
 NEG_INF = float("-inf")
 
 
-def causal_block_mask(q_len: int, kv_len: int, q_offset, kv_offset):
+def causal_block_mask(q_len: int, kv_len: int, q_offset, kv_offset,
+                      window: int | None = None):
     """Additive mask (q_len, kv_len) for a block of a causal attention
-    matrix whose global coordinates start at (q_offset, kv_offset).
+    matrix whose global coordinates start at (q_offset, kv_offset);
+    ``window=W`` additionally masks keys older than ``qpos - W + 1``
+    (the causal sliding window).
 
     Offsets may be traced scalars (ring steps compute the kv offset from
     the rotating source index) — only the lengths must be static.
     """
     qi = q_offset + jnp.arange(q_len)[:, None]
     kj = kv_offset + jnp.arange(kv_len)[None, :]
-    return jnp.where(kj > qi, NEG_INF, 0.0).astype(jnp.float32)
+    dead = kj > qi
+    if window is not None:
+        dead = dead | (kj <= qi - window)
+    return jnp.where(dead, NEG_INF, 0.0).astype(jnp.float32)
 
 
 def softmax_block_update(carry, q, k, v, scale, mask=None):
@@ -68,21 +74,30 @@ def finalize_softmax(l, acc, dtype):
     return (acc / denom).astype(dtype)
 
 
-def dense_attention(q, k, v, *, causal: bool = False, scale=None,
+def dense_attention(q, k, v, *, causal: bool = False,
+                    window: int | None = None, scale=None,
                     q_offset: int = 0, kv_offset: int = 0):
     """Reference multi-head attention, (B, S, H, D) layout.
 
     Single fused einsum-softmax-einsum — exactly what XLA fuses well on one
     chip; the parallel layer (:mod:`mmlspark_tpu.parallel.context_parallel`)
     decomposes the same math across devices and must match this output.
+    ``window`` is the causal sliding window (same semantics as the flash
+    kernel: each query sees its W most recent keys; requires causal).
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if int(window) < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
     if causal:
-        s = s + causal_block_mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        s = s + causal_block_mask(q.shape[1], k.shape[1], q_offset,
+                                  kv_offset, window=window)
     m = s.max(axis=-1, keepdims=True)
     m = jnp.where(jnp.isneginf(m), 0.0, m)
     p = jnp.exp(s - m)

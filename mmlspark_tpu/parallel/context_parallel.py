@@ -39,7 +39,8 @@ from mmlspark_tpu.ops.attention import (
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 
 
-def _ring_inner(q, k, v, *, axis_name: str, causal: bool, scale):
+def _ring_inner(q, k, v, *, axis_name: str, causal: bool,
+                window: int | None, scale):
     """Per-shard ring attention body (runs under shard_map).
 
     q, k, v: local sequence chunks (B, S/n, H, D). Chunk ownership after
@@ -62,7 +63,8 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool, scale):
         m, l, acc, kc, vc = carry
         src = (idx - step) % n
         mask = (
-            causal_block_mask(sq, sk, idx * sq, src * sk) if causal else None
+            causal_block_mask(sq, sk, idx * sq, src * sk, window=window)
+            if causal else None
         )
         m, l, acc = softmax_block_update((m, l, acc), q, kc, vc, scale, mask)
         kc = lax.ppermute(kc, axis_name, perm)
@@ -75,7 +77,8 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool, scale):
     return finalize_softmax(l, acc, q.dtype)
 
 
-def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool, scale):
+def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool,
+                   window: int | None, scale):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern): trade
     the sequence sharding for head sharding, attend locally, trade back.
 
@@ -94,9 +97,11 @@ def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool, scale):
     if is_tpu():
         from mmlspark_tpu.ops.flash_attention import flash_attention
 
-        o = flash_attention(q, k, v, causal=causal, scale=scale)
+        o = flash_attention(q, k, v, causal=causal, window=window,
+                            scale=scale)
     else:
-        o = dense_attention(q, k, v, causal=causal, scale=scale)
+        o = dense_attention(q, k, v, causal=causal, window=window,
+                            scale=scale)
     # back to sequence-sharded layout
     return a2a(o, split_axis=1, concat_axis=2)
 
@@ -120,21 +125,29 @@ def _sharded_call(inner, q, k, v, mesh, axis: str, batch_axis: str):
 
 
 def ring_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
-                   causal: bool = False, scale=None,
-                   batch_axis: str = DATA_AXIS):
+                   causal: bool = False, window: int | None = None,
+                   scale=None, batch_axis: str = DATA_AXIS):
     """Exact attention with q/k/v sharded on ``axis`` over ``mesh``.
 
     Works inside or outside an enclosing ``jit``; XLA reshards inputs to
-    the sequence layout if they arrive otherwise.
+    the sequence layout if they arrive otherwise. ``window`` is the
+    causal sliding window (flash-kernel semantics), applied through the
+    per-step block mask.
     """
+    if window is not None:
+        if not causal:
+            raise FriendlyError("window requires causal=True")
+        if int(window) < 1:
+            raise FriendlyError(f"window must be >= 1, got {window}")
     _check(mesh, axis, q.shape[1], "ring")
-    inner = partial(_ring_inner, axis_name=axis, causal=causal, scale=scale)
+    inner = partial(_ring_inner, axis_name=axis, causal=causal,
+                    window=window, scale=scale)
     return _sharded_call(inner, q, k, v, mesh, axis, batch_axis)
 
 
 def ulysses_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
-                      causal: bool = False, scale=None,
-                      batch_axis: str = DATA_AXIS):
+                      causal: bool = False, window: int | None = None,
+                      scale=None, batch_axis: str = DATA_AXIS):
     """All-to-all sequence-parallel attention; heads must divide by the
     axis size (each device attends H/n full-length heads)."""
     n = _check(mesh, axis, q.shape[1], "ulysses")
@@ -143,8 +156,13 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
             f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
             f"'{axis}' ({n})"
         )
+    if window is not None:
+        if not causal:
+            raise FriendlyError("window requires causal=True")
+        if int(window) < 1:
+            raise FriendlyError(f"window must be >= 1, got {window}")
     inner = partial(_ulysses_inner, axis_name=axis, causal=causal,
-                    scale=scale)
+                    window=window, scale=scale)
     return _sharded_call(inner, q, k, v, mesh, axis, batch_axis)
 
 

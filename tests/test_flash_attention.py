@@ -216,12 +216,28 @@ def test_transformer_lm_sliding_window():
     assert not np.allclose(base[0, -1], out3[0, -1], atol=1e-5)
 
 
-def test_window_rejected_for_non_flash_impl():
-    from mmlspark_tpu.core.exceptions import ParamError
+def test_window_uniform_across_dense_and_flash():
+    """window is one feature across impls: the dense path and the flash
+    kernel produce the same windowed function for identical params."""
     from mmlspark_tpu.models.registry import build_model
 
-    m = build_model("transformer_lm", vocab_size=32, d_model=16, heads=2,
-                    depth=1, max_len=16, attn_impl="dense", window=4)
-    x = jnp.zeros((1, 16), jnp.int32)
-    with pytest.raises(ParamError, match="flash"):
-        m.init(jax.random.PRNGKey(0), x)
+    x = jnp.asarray(np.arange(16)[None] % 32, jnp.int32)
+    outs = {}
+    for impl in ("dense", "flash"):
+        m = build_model("transformer_lm", vocab_size=32, d_model=16,
+                        heads=2, depth=1, max_len=16, attn_impl=impl,
+                        window=5)
+        vars_ = m.init(jax.random.PRNGKey(0), x)  # same seed -> same params
+        outs[impl] = np.asarray(
+            jax.jit(m.apply)(vars_, x), np.float32
+        )
+    np.testing.assert_allclose(outs["dense"], outs["flash"],
+                               atol=2e-2, rtol=2e-2)  # bf16 activations
+
+
+def test_dense_window_requires_causal():
+    from mmlspark_tpu.ops.attention import dense_attention
+
+    q = jnp.ones((1, 8, 1, 4), jnp.float32)
+    with pytest.raises(ValueError, match="causal"):
+        dense_attention(q, q, q, window=4)

@@ -180,3 +180,24 @@ def test_ulysses_flash_inner_matches_dense(rng, monkeypatch):
     got = np.asarray(cp.ulysses_attention(q, k, v, mesh, causal=True))
     want = np.asarray(dense_attention(q, k, v, causal=True))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_and_ulysses_sliding_window_match_dense():
+    """window threads through both sequence-parallel paths: each shard's
+    block masks reproduce the dense windowed function exactly."""
+    mesh = make_mesh({"seq": 4})
+    rng = np.random.default_rng(9)
+    S, W = 32, 9
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, S, 4, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    want = np.asarray(dense_attention(q, k, v, causal=True, window=W))
+    got_ring = np.asarray(
+        ring_attention(q, k, v, mesh, causal=True, window=W)
+    )
+    got_uly = np.asarray(
+        ulysses_attention(q, k, v, mesh, causal=True, window=W)
+    )
+    np.testing.assert_allclose(got_ring, want, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got_uly, want, atol=1e-5, rtol=1e-5)
