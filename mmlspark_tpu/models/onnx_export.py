@@ -311,6 +311,14 @@ def _export_transformer_lm(graph, variables, sample_shape):
         variables[blocks[0]], "params", "attn", "qkv", "kernel"
     ).shape[1]
     if hd3 != 3 * d_model:
+        if extra.get("kv_heads"):
+            # (h + 2*hk)*d layout — exporting would need in-graph K/V
+            # head expansion; reject with the real reason
+            raise FriendlyError(
+                "transformer_lm ONNX export does not support "
+                f"grouped-query attention yet (kv_heads="
+                f"{extra['kv_heads']}); export an MHA model"
+            )
         raise FriendlyError(
             f"qkv kernel must be (E, 3E); got 3HD={hd3} for E={d_model}"
         )

@@ -71,6 +71,7 @@ class SelfAttention(nn.Module):
     causal: bool
     attn_impl: str = DENSE
     window: int | None = None  # causal sliding window (all impls)
+    kv_heads: int | None = None  # grouped-query attention (None = MHA)
     mesh: Any = None  # jax.sharding.Mesh (hashable -> valid static attr)
     dtype: Any = jnp.bfloat16
 
@@ -78,15 +79,26 @@ class SelfAttention(nn.Module):
     def __call__(self, x):
         b, t, _ = x.shape
         h, d = self.heads, self.head_dim
+        hk = self.kv_heads or h
         x = x.astype(self.dtype)
-        qkv = nn.Dense(3 * h * d, dtype=self.dtype,
+        # one fused projection; under GQA the K/V slices are narrower
+        # (hk heads), shrinking both the projection and the KV tensors
+        qkv = nn.Dense((h + 2 * hk) * d, dtype=self.dtype,
                        param_dtype=jnp.float32, name="qkv")(x)
-        q, k, v = jnp.split(qkv.reshape(b, t, 3 * h, d), 3, axis=2)
+        qkv = qkv.reshape(b, t, h + 2 * hk, d)
+        q = qkv[:, :, :h]
+        k = qkv[:, :, h:h + hk]
+        v = qkv[:, :, h + hk:]
         if self.attn_impl not in ATTN_IMPLS:
             raise ParamError(
                 f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
             )
         impl = resolve_attn_impl(self.attn_impl)
+        if hk != h and impl in (RING, ULYSSES) and self.mesh is not None:
+            raise ParamError(
+                "kv_heads (grouped-query attention) is supported by the "
+                f"dense and flash paths; attn_impl resolved to '{impl}'"
+            )
         if impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 
@@ -125,14 +137,15 @@ class Block(nn.Module):
     mesh: Any
     dtype: Any = jnp.bfloat16
     window: int | None = None
+    kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
-            window=self.window, mesh=self.mesh, dtype=self.dtype,
-            name="attn",
+            window=self.window, kv_heads=self.kv_heads, mesh=self.mesh,
+            dtype=self.dtype, name="attn",
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = nn.Dense(self.d_ff, dtype=self.dtype, param_dtype=jnp.float32,
@@ -166,6 +179,7 @@ def transformer_lm(
     causal: bool = True,
     attn_impl: str = AUTO,
     window: int | None = None,
+    kv_heads: int | None = None,
     mesh: Any = None,
 ) -> NamedGraph:
     """Decoder-only LM (or bidirectional encoder with ``causal=False``);
@@ -182,6 +196,13 @@ def transformer_lm(
             )
         if int(window) < 1:
             raise ParamError(f"window must be >= 1, got {window}")
+    if kv_heads is not None and (
+        kv_heads < 1 or heads % kv_heads
+    ):
+        raise ParamError(
+            f"kv_heads ({kv_heads}) must be >= 1 and divide heads "
+            f"({heads})"
+        )
     if attn_impl not in ATTN_IMPLS:
         raise ParamError(
             f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
@@ -196,7 +217,7 @@ def transformer_lm(
             (
                 f"block{i}",
                 Block(heads, d_model // heads, d_ff, causal, attn_impl,
-                      mesh, window=window),
+                      mesh, window=window, kv_heads=kv_heads),
             )
         )
     blocks.append((FINAL_NODE, LMHead(vocab_size)))
@@ -210,5 +231,6 @@ def transformer_lm(
             "causal": causal,
             "heads": heads,
             "window": window,
+            "kv_heads": kv_heads,
         },
     )

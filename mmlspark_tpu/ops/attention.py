@@ -90,6 +90,18 @@ def dense_attention(q, k, v, *, causal: bool = False,
             raise ValueError("window requires causal=True")
         if int(window) < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if k.shape[2] != v.shape[2] or q.shape[2] % k.shape[2]:
+        raise ValueError(
+            "k/v heads must be equal and divide q heads, got "
+            f"q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
+        )
+    if k.shape[2] != q.shape[2]:
+        # grouped-query attention, same convention as the flash kernel
+        # (query head i -> kv head i // group); the dense REFERENCE just
+        # repeats — the kernel is where the no-copy expansion lives
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     s = jnp.einsum(

@@ -194,6 +194,10 @@ def _flash_forward(q, k, v, *, causal: bool, window: int | None,
                    scale: float, block: int, interpret: bool,
                    with_lse: bool = True):
     b, s, h, d = q.shape
+    # grouped-query attention: K/V may carry fewer heads (h_kv) than Q;
+    # the group factor g maps query-head grid index bh -> kv row bh // g
+    # in the index maps, so K/V are never materialized per query head
+    g = h // k.shape[2]
     blk = min(block, _round_up(s, 8))
     s_pad = _round_up(s, blk)
     qb, kb, vb = (_to_bh(t, s_pad) for t in (q, k, v))
@@ -218,9 +222,9 @@ def _flash_forward(q, k, v, *, causal: bool, window: int | None,
     if causal:
         def kv_im(bh, i, j):
             lo, hi = _live_k_range(i, window=window, blk=blk)
-            return (bh, jnp.clip(j, lo, hi), 0)
+            return (bh // g, jnp.clip(j, lo, hi), 0)
     else:
-        kv_im = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+        kv_im = lambda bh, i, j: (bh // g, j, 0)  # noqa: E731
     res = pl.pallas_call(
         partial(_fwd_kernel, scale=scale, causal=causal, window=window,
                 blk=blk, seq_len=s, with_lse=with_lse,
@@ -349,6 +353,13 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool,
                     window: int | None, scale: float, block: int,
                     interpret: bool):
     b, s, h, d = q.shape
+    # GQA: the dK/dV kernel runs per QUERY head (accumulating across the
+    # group inside the kernel would race the parallel bh grid dim), so
+    # its outputs are per-query-head and reduced over the group in XLA
+    # afterwards; K/V inputs are group-indexed via bh // grp, never
+    # materialized per query head
+    h_kv = k.shape[2]
+    grp = h // h_kv
     blk = min(block, _round_up(s, 8))
     s_pad = _round_up(s, blk)
     qb, kb, vb, dob = (_to_bh(t, s_pad) for t in (q, k, v, g))
@@ -380,10 +391,14 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool,
 
         def kv_side_q(bh, i, j):
             lo, hi = _live_k_range(i, window=window, blk=blk)
-            return (bh, jnp.clip(j, lo, hi), 0)
+            return (bh // grp, jnp.clip(j, lo, hi), 0)
+
+        def kv_in_kvgrid(bh, j, i):
+            return (bh // grp, j, 0)
     else:
         q_side_kv = lambda bh, j, i: (bh, i, 0)  # noqa: E731
-        kv_side_q = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+        kv_side_q = lambda bh, i, j: (bh // grp, j, 0)  # noqa: E731
+        kv_in_kvgrid = lambda bh, j, i: (bh // grp, j, 0)  # noqa: E731
     # dK / dV: fix the k block, stream q blocks (qi is the fastest grid dim)
     dkb, dvb = pl.pallas_call(
         partial(_bwd_kv_kernel, scale=scale, causal=causal,
@@ -398,8 +413,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool,
             tile(q_side_kv),                    # dO
             rep(q_side_kv),                     # LSE
             rep(q_side_kv),                     # D
-            tile(lambda bh, j, i: (bh, j, 0)),  # K
-            tile(lambda bh, j, i: (bh, j, 0)),  # V
+            tile(kv_in_kvgrid),                 # K
+            tile(kv_in_kvgrid),                 # V
         ],
         out_specs=(
             tile(lambda bh, j, i: (bh, j, 0)),
@@ -433,8 +448,19 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool,
         interpret=interpret,
     )(kb, vb, qb, dob, lse, dd)
 
-    unpack = lambda t: _from_bh(t, b, h, s)
-    return unpack(dqb), unpack(dkb), unpack(dvb)
+    dq = _from_bh(dqb, b, h, s)
+    dk = _from_bh(dkb, b, h, s)
+    dv = _from_bh(dvb, b, h, s)
+    if grp > 1:
+        # reduce per-query-head dK/dV over the group -> (B, S, h_kv, D);
+        # sum in f32: each addend was already rounded to the input dtype
+        # once leaving the kernel, and a bf16 tree of grp addends would
+        # compound that rounding exactly in the large-group (MQA) configs
+        dk = dk.reshape(b, s, h_kv, grp, d).astype(jnp.float32).sum(
+            axis=3).astype(k.dtype)
+        dv = dv.reshape(b, s, h_kv, grp, d).astype(jnp.float32).sum(
+            axis=3).astype(v.dtype)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -478,6 +504,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """Blockwise fused attention, (B, S, H, D) layout, exact output AND
     exact gradients — both directions O(S·d) memory.
 
+    Grouped-query attention is supported by passing k/v with fewer heads
+    (h_kv dividing h_q): query head i attends kv head ``i // group``.
+    The kernels expand K/V on the fly through their grid index maps —
+    no per-query-head copy is ever materialized; dK/dV are reduced over
+    the group after the per-query-head kernel pass.
+
     ``window=W`` restricts each query to the W most recent keys
     (positions ``qpos - W + 1 .. qpos``, Mistral-style sliding window;
     requires ``causal=True``). Work AND streamed HBM traffic then scale
@@ -495,6 +527,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError(
             "flash_attention requires q, k, v to share one dtype, got "
             f"{q.dtype}/{k.dtype}/{v.dtype}"
+        )
+    if k.shape[2] != v.shape[2] or q.shape[2] % k.shape[2]:
+        # grouped-query attention: adjacent query heads share a kv head
+        # (query head i reads kv head i // (h_q // h_kv))
+        raise ValueError(
+            "flash_attention needs k/v heads equal and dividing q heads, "
+            f"got q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
         )
     if window is not None:
         if not causal:
