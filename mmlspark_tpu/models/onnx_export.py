@@ -301,6 +301,11 @@ def _export_transformer_lm(graph, variables, sample_shape):
     extra = graph.extra
     causal = bool(extra.get("causal", True))
     emb = _np(variables["embed"], "params", "token", "embedding")
+    if extra.get("pos_embedding") == "rope":
+        raise FriendlyError(
+            "transformer_lm ONNX export does not support RoPE yet "
+            "(pos_embedding='rope'); export a learned-position model"
+        )
     pos = _np(variables["embed"], "params", "pos")[:seq]
     d_model = emb.shape[1]
     blocks = [n for n in graph.layer_names if n.startswith("block")]

@@ -52,12 +52,15 @@ class TokenPosEmbed(nn.Module):
     vocab_size: int
     d_model: int
     max_len: int
+    learned_pos: bool = True  # False: tokens only (RoPE in attention)
 
     @nn.compact
     def __call__(self, ids):
         # ids: (B, T) int
         tok = nn.Embed(self.vocab_size, self.d_model,
                        param_dtype=jnp.float32, name="token")(ids)
+        if not self.learned_pos:
+            return tok
         pos = self.param(
             "pos", nn.initializers.normal(0.02),
             (self.max_len, self.d_model), jnp.float32,
@@ -72,6 +75,7 @@ class SelfAttention(nn.Module):
     attn_impl: str = DENSE
     window: int | None = None  # causal sliding window (all impls)
     kv_heads: int | None = None  # grouped-query attention (None = MHA)
+    rope: bool = False  # rotary position embeddings on q/k
     mesh: Any = None  # jax.sharding.Mesh (hashable -> valid static attr)
     dtype: Any = jnp.bfloat16
 
@@ -89,6 +93,11 @@ class SelfAttention(nn.Module):
         q = qkv[:, :, :h]
         k = qkv[:, :, h:h + hk]
         v = qkv[:, :, h + hk:]
+        if self.rope:
+            from mmlspark_tpu.ops.rope import apply_rope
+
+            q = apply_rope(q)
+            k = apply_rope(k)
         if self.attn_impl not in ATTN_IMPLS:
             raise ParamError(
                 f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
@@ -138,14 +147,15 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     window: int | None = None
     kv_heads: int | None = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
-            window=self.window, kv_heads=self.kv_heads, mesh=self.mesh,
-            dtype=self.dtype, name="attn",
+            window=self.window, kv_heads=self.kv_heads, rope=self.rope,
+            mesh=self.mesh, dtype=self.dtype, name="attn",
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = nn.Dense(self.d_ff, dtype=self.dtype, param_dtype=jnp.float32,
@@ -180,6 +190,7 @@ def transformer_lm(
     attn_impl: str = AUTO,
     window: int | None = None,
     kv_heads: int | None = None,
+    pos_embedding: str = "learned",
     mesh: Any = None,
 ) -> NamedGraph:
     """Decoder-only LM (or bidirectional encoder with ``causal=False``);
@@ -203,6 +214,17 @@ def transformer_lm(
             f"kv_heads ({kv_heads}) must be >= 1 and divide heads "
             f"({heads})"
         )
+    if pos_embedding not in ("learned", "rope"):
+        raise ParamError(
+            f"pos_embedding must be 'learned' or 'rope', got "
+            f"'{pos_embedding}'"
+        )
+    if pos_embedding == "rope" and (d_model // heads) % 2:
+        raise ParamError(
+            f"RoPE needs an even head_dim; d_model//heads = "
+            f"{d_model // heads}"
+        )
+    rope = pos_embedding == "rope"
     if attn_impl not in ATTN_IMPLS:
         raise ParamError(
             f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"
@@ -210,14 +232,15 @@ def transformer_lm(
     attn_impl = resolve_attn_impl(attn_impl)
     d_ff = d_ff or 4 * d_model
     blocks: list[tuple[str, Any]] = [
-        ("embed", TokenPosEmbed(vocab_size, d_model, max_len))
+        ("embed", TokenPosEmbed(vocab_size, d_model, max_len,
+                                learned_pos=not rope))
     ]
     for i in range(depth):
         blocks.append(
             (
                 f"block{i}",
                 Block(heads, d_model // heads, d_ff, causal, attn_impl,
-                      mesh, window=window, kv_heads=kv_heads),
+                      mesh, window=window, kv_heads=kv_heads, rope=rope),
             )
         )
     blocks.append((FINAL_NODE, LMHead(vocab_size)))
@@ -232,5 +255,6 @@ def transformer_lm(
             "heads": heads,
             "window": window,
             "kv_heads": kv_heads,
+            "pos_embedding": pos_embedding,
         },
     )

@@ -1,0 +1,52 @@
+"""Rotary position embeddings (RoPE) for the transformer family.
+
+Applies the standard rotate-half formulation (GPT-NeoX convention): the
+head dimension is split into two halves which form the (real, imaginary)
+parts of d/2 complex pairs, and each pair is rotated by an angle
+proportional to the token position — making the q·k dot product a
+function of RELATIVE position only. No learned parameters, no (S, E)
+positional table in the checkpoint, and positions beyond training length
+extrapolate structurally.
+
+TPU notes: the cos/sin tables are computed at trace time as (S, D/2)
+f32 constants, broadcast over (B, H) — elementwise work XLA fuses
+straight into the surrounding projections; no gather is involved
+(positions are an iota unless explicitly provided).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_tables(positions, head_dim: int, base: float = 10000.0):
+    """cos/sin tables, each (len(positions), head_dim // 2) float32.
+
+    ``positions`` is any integer/float vector — contiguous iota for the
+    common case, but arbitrary (e.g. cache offsets) values work.
+    """
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    inv_freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, positions=None, *, base: float = 10000.0):
+    """Rotate ``x`` of shape (B, S, H, D) by position; D must be even.
+
+    ``positions`` defaults to 0..S-1. The rotation is applied in f32 and
+    cast back to ``x.dtype`` (bf16 activations keep their dtype through
+    the attention stack).
+    """
+    b, s, h, d = x.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    cos, sin = rope_tables(positions, d, base)  # (S, D/2)
+    cos = cos[None, :, None, :]  # broadcast over (B, H)
+    sin = sin[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    return jnp.concatenate(
+        (x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1
+    ).astype(x.dtype)

@@ -319,3 +319,64 @@ def test_transformer_lm_gqa():
     with pytest.raises(ParamError, match="kv_heads"):
         build_model("transformer_lm", vocab_size=32, d_model=16, heads=4,
                     depth=1, max_len=16, kv_heads=3)
+
+
+def test_rope_relative_position_invariance():
+    """<rope(q,p), rope(k,p')> depends only on p - p': shifting both
+    positions by a constant leaves every pairwise dot product unchanged."""
+    from mmlspark_tpu.ops.rope import apply_rope
+
+    rng = np.random.default_rng(13)
+    q, k = (
+        jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+        for _ in range(2)
+    )
+    def dots(shift):
+        pos = jnp.arange(8) + shift
+        qr = apply_rope(q, pos)
+        kr = apply_rope(k, pos)
+        return np.asarray(jnp.einsum("bqhd,bkhd->bhqk", qr, kr))
+    np.testing.assert_allclose(dots(0), dots(100), atol=1e-4, rtol=1e-4)
+
+
+def test_rope_preserves_norm_and_dtype():
+    from mmlspark_tpu.ops.rope import apply_rope
+
+    rng = np.random.default_rng(14)
+    x = jnp.asarray(rng.normal(size=(2, 16, 2, 8)), jnp.bfloat16)
+    r = apply_rope(x)
+    assert r.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x, np.float32), axis=-1),
+        np.linalg.norm(np.asarray(r, np.float32), axis=-1),
+        atol=2e-1, rtol=2e-2,  # bf16 storage
+    )
+    with pytest.raises(ValueError, match="even"):
+        apply_rope(jnp.ones((1, 4, 1, 5), jnp.float32))
+
+
+def test_transformer_lm_rope():
+    """pos_embedding='rope': no learned position table in the params,
+    forward+grad runs, and the ONNX exporter rejects with the reason."""
+    from mmlspark_tpu.core.exceptions import FriendlyError, ParamError
+    from mmlspark_tpu.models.onnx_export import export_onnx
+    from mmlspark_tpu.models.registry import build_model
+
+    m = build_model("transformer_lm", vocab_size=32, d_model=16, heads=2,
+                    depth=1, max_len=16, attn_impl="flash",
+                    pos_embedding="rope")
+    assert m.extra["pos_embedding"] == "rope"
+    x = jnp.asarray(np.arange(16)[None] % 32, jnp.int32)
+    vars_ = m.init(jax.random.PRNGKey(0), x)
+    assert "pos" not in vars_["embed"]["params"]
+    loss = jax.jit(lambda p: jnp.mean(
+        m.apply(p, x).astype(jnp.float32) ** 2))
+    assert float(loss(vars_)) > 0
+    g = jax.jit(jax.grad(loss))(vars_)
+    assert jax.tree_util.tree_reduce(
+        lambda a, b: a + float(jnp.sum(jnp.abs(b))), g, 0.0) > 0
+    with pytest.raises(FriendlyError, match="RoPE"):
+        export_onnx(m, vars_, (1, 16))
+    with pytest.raises(ParamError, match="pos_embedding"):
+        build_model("transformer_lm", vocab_size=32, d_model=16, heads=2,
+                    depth=1, max_len=16, pos_embedding="alibi")
