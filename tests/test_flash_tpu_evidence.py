@@ -81,6 +81,13 @@ def test_flash_tpu_evidence_artifact_contract():
         assert n["fwd_max_abs_err"] > 0  # recorded raw, not gated
         for key in ("dq", "dk", "dv"):
             assert n[f"{key}_scaled_err"] <= tol
+    # present in artifacts recorded after sliding-window + GQA landed
+    if "window_gqa" in ev["numerics"]:
+        wg = ev["numerics"]["window_gqa"]
+        assert wg["fwd_scaled_err"] <= tol
+        assert wg["window"] >= 1 and wg["kv_heads"] >= 1
+        for key in ("dq", "dk", "dv"):
+            assert wg[f"{key}_scaled_err"] <= tol
     blocks = {k: t for k, t in ev["timing"].items()
               if k.startswith("block_")}
     assert blocks, "block sweep missing"

@@ -7,10 +7,14 @@ OUTSIDE interpreter mode on the chip:
 
 1. compiles forward + backward at (B=4, S=2048, H=8, D=64) bfloat16,
 2. asserts numerics against the XLA einsum-softmax reference — forward
-   and all three input gradients, causal and non-causal, gated on
-   SCALE-NORMALIZED error (max abs err / max(1, max|want|) <= 1e-2;
-   see ``_scaled_err`` for why raw abs error is the wrong metric on a
-   platform whose precision is relative to magnitude),
+   and all three input gradients, causal and non-causal, PLUS a
+   sliding-window + grouped-query case (window=256, kv_heads=2, fwd and
+   grads vs the dense reference: the window-edge dead-block skipping
+   and dK/dV group reduction are compiled paths the plain legs never
+   execute) — gated on SCALE-NORMALIZED error (max abs err /
+   max(1, max|want|) <= 1e-2; see ``_scaled_err`` for why raw abs error
+   is the wrong metric on a platform whose precision is relative to
+   magnitude),
 3. times a block-size sweep (128/256/512) of the compiled forward and
    forward+backward with bench.py's ``_chained_op_seconds`` harness —
    the DIFFERENCE of two ``lax.scan``-chained runs (n1=8, n2=40 data-
@@ -169,6 +173,62 @@ def main() -> None:
         print(f"numerics[{name}]: fwd {fwd_err:.2e} (abs {fwd_abs:.2e}) "
               "grads "
               + " ".join(f"{n}={e:.2e}" for n, e in grad_errs.items()))
+
+    # -- numerics: sliding window + GQA, compiled, vs dense reference ------
+    # the dense reference handles the kv-head repeat and window mask
+    # (tests pin its exactness on CPU); here it certifies the COMPILED
+    # kernel's windowed/grouped paths on the chip
+    from mmlspark_tpu.ops.attention import dense_attention
+
+    W, HKV = 256, 2
+    kg, vg = (
+        jnp.asarray(rng.normal(size=(B, S, HKV, D)), jnp.bfloat16)
+        for _ in range(2)
+    )
+    wout = np.asarray(jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=W, interpret=False)
+    )(q, kg, vg), np.float32)
+    wwant = np.asarray(jax.jit(
+        lambda q, k, v: dense_attention(q, k, v, causal=True, window=W)
+    )(q, kg, vg), np.float32)
+    werr = _scaled_err(wout, wwant)
+
+    # ...and the backward: the window-edge dead-block skipping and the
+    # dK/dV group reduction are window/GQA-specific compiled paths that
+    # the full/causal legs above never execute
+    def wloss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, window=W,
+                            interpret=False)
+            .astype(jnp.float32) * g.astype(jnp.float32)
+        )
+
+    def wloss_ref(q, k, v):
+        return jnp.sum(
+            dense_attention(q, k, v, causal=True, window=W)
+            .astype(jnp.float32) * g.astype(jnp.float32)
+        )
+
+    wgf = jax.jit(jax.grad(wloss_flash, argnums=(0, 1, 2)))(q, kg, vg)
+    wgr = jax.jit(jax.grad(wloss_ref, argnums=(0, 1, 2)))(q, kg, vg)
+    wgrad_errs = {
+        n: _scaled_err(np.asarray(a, np.float32),
+                       np.asarray(b, np.float32))
+        for n, a, b in zip(("dq", "dk", "dv"), wgf, wgr)
+    }
+    evidence["numerics"]["window_gqa"] = {
+        "window": W, "kv_heads": HKV,
+        "fwd_scaled_err": werr,
+        "fwd_max_abs_err": float(np.max(np.abs(wout - wwant))),
+        **{f"{n}_scaled_err": e for n, e in wgrad_errs.items()},
+    }
+    assert werr <= TOL, ("window_gqa", werr)
+    assert all(e <= TOL for e in wgrad_errs.values()), (
+        "window_gqa", wgrad_errs)
+    print(f"numerics[window_gqa]: fwd {werr:.2e} (W={W}, h_kv={HKV}) "
+          "grads "
+          + " ".join(f"{n}={e:.2e}" for n, e in wgrad_errs.items()))
 
     # -- timing: block sweep, forward and forward+backward -----------------
     # A single dispatch over the axon relay costs tens of ms of tunnel
