@@ -39,6 +39,22 @@ from mmlspark_tpu.ops.attention import (
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 
 
+def _ring_window_steps(n: int, chunk: int, window: int | None,
+                       causal: bool) -> int:
+    """Number of LIVE ring rotations. Causal+window bounds the oldest
+    attended key of q chunk i at ``i*chunk - window + 1``; rotation t
+    hands device i the kv chunk i - t (older positions as t grows), and
+    the chunk at t is fully outside the window iff
+    ``t*chunk > window + chunk - 2`` — a bound INDEPENDENT of i, so the
+    dead rotations (their compute and their ppermute hops) can be
+    dropped for every device at once: windowed ring attention
+    communicates O(window), not O(S). Rotations t > i wrap to
+    causal-dead chunks anyway, so dropping the tail is exact."""
+    if not causal or window is None:
+        return n
+    return min(n, (window + chunk - 2) // chunk + 1)
+
+
 def _ring_inner(q, k, v, *, axis_name: str, causal: bool,
                 window: int | None, scale):
     """Per-shard ring attention body (runs under shard_map).
@@ -58,6 +74,7 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool,
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
+    n_steps = _ring_window_steps(n, sk, window, causal)
 
     def body(carry, step):
         m, l, acc, kc, vc = carry
@@ -72,7 +89,7 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool,
         return (m, l, acc, kc, vc), ()
 
     (m, l, acc, _, _), _ = lax.scan(
-        body, (m0, l0, acc0, k, v), jnp.arange(n)
+        body, (m0, l0, acc0, k, v), jnp.arange(n_steps)
     )
     return finalize_softmax(l, acc, q.dtype)
 

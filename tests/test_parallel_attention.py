@@ -201,3 +201,68 @@ def test_ring_and_ulysses_sliding_window_match_dense():
     )
     np.testing.assert_allclose(got_ring, want, atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(got_uly, want, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_window_step_bound():
+    """Windowed ring attention drops whole rotations: the live-rotation
+    count is independent of device index and O(window / chunk)."""
+    from mmlspark_tpu.parallel.context_parallel import _ring_window_steps
+
+    # no window / non-causal: every rotation runs
+    assert _ring_window_steps(8, 16, None, True) == 8
+    assert _ring_window_steps(8, 16, 64, False) == 8
+    # window inside one chunk: the own chunk + one older neighbor
+    assert _ring_window_steps(8, 16, 1, True) == 1
+    assert _ring_window_steps(8, 16, 16, True) == 2
+    # window spanning chunks; never exceeds n. window=17 from the oldest
+    # query row (pos i*c) reaches pos i*c - 16: still chunk i-1 -> 2
+    # rotations; 18 reaches i*c - 17: chunk i-2 -> 3
+    assert _ring_window_steps(8, 16, 17, True) == 2
+    assert _ring_window_steps(8, 16, 18, True) == 3
+    assert _ring_window_steps(8, 16, 1000, True) == 8
+
+
+@pytest.mark.parametrize("window", [1, 5, 8, 9, 24])
+def test_ring_window_skipped_rotations_exact(window):
+    """Correctness across the skip boundary: windows smaller than, equal
+    to, and spanning the per-device chunk (S=32 over 4 devices -> chunk
+    8) all reproduce the dense windowed function."""
+    mesh = make_mesh({"seq": 4})
+    rng = np.random.default_rng(15)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 32, 4, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    want = np.asarray(dense_attention(q, k, v, causal=True, window=window))
+    got = np.asarray(
+        ring_attention(q, k, v, mesh, causal=True, window=window)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_window_gradients_match_dense():
+    """Differentiability through the TRUNCATED scan (n_steps < n): a
+    broken transpose of the shortened rotation loop would surface here."""
+    mesh = make_mesh({"seq": 4})
+    rng = np.random.default_rng(16)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    g = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+    W = 9  # 2 of 4 rotations live
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            ring_attention(q, k, v, mesh, causal=True, window=W) * g),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dense_attention(q, k, v, causal=True, window=W) * g),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
