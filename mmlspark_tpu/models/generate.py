@@ -1,0 +1,105 @@
+"""Autoregressive generation for the causal transformer family.
+
+The reference has no generative model at all (its only sequence model is
+a downloaded BiLSTM tagger, notebook 304); generation is part of the
+long-context capability upgrade. This is the EXACT fixed-shape decode:
+one `lax.scan` over steps, each step a full forward over a static
+(B, P+N) buffer whose future positions are causally masked out — so the
+whole loop jits once, runs for any prompt, and works unchanged with
+every attention configuration (dense/flash, sliding window, GQA, RoPE).
+
+Cost note: recomputing the prefix makes a step O(T·W) with a sliding
+window (W = window) and O(T²) without — the right trade at this
+framework's model scale, where one fused forward per token keeps the
+MXU busy and avoids threading mutable KV-cache state through the
+NamedGraph block chain. ``window=`` models are therefore the natural
+long-generation configuration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+
+
+def generate(graph, variables, prompt, max_new_tokens: int, *,
+             temperature: float = 0.0, rng=None, pad_id: int = 0):
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    ``graph`` must be a causal LM whose ``apply`` returns per-position
+    logits (the ``transformer_lm`` family); ``prompt`` is (B, P) int32.
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at
+    the given temperature using ``rng`` (required then). Returns the
+    (B, P + max_new_tokens) int32 buffer including the prompt.
+    """
+    if not graph.extra.get("causal", False):
+        raise FriendlyError(
+            f"generate() needs a causal LM; '{graph.name}' has "
+            "causal=False (bidirectional logits leak future positions)"
+        )
+    if graph.extra.get("n_experts"):
+        # expert-capacity routing is NOT causal: the buffer's pad-filled
+        # future positions would be routed too, consuming capacity slots
+        # ahead of later batch rows' real tokens and silently changing
+        # their logits vs a prompt-length forward
+        raise FriendlyError(
+            f"generate() does not support MoE routing ('{graph.name}'): "
+            "capacity-based dispatch over the fixed decode buffer is not "
+            "causal; use a dense-FFN transformer_lm"
+        )
+    if max_new_tokens < 1:
+        raise FriendlyError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if temperature < 0.0:
+        raise FriendlyError(
+            f"temperature must be >= 0, got {temperature} (0 = greedy)"
+        )
+    if temperature > 0.0 and rng is None:
+        raise FriendlyError("sampling (temperature > 0) needs rng")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_len = graph.input_shape[0] if graph.input_shape else None
+    if (
+        max_len
+        and total > max_len
+        and graph.extra.get("pos_embedding", "learned") == "learned"
+    ):
+        # the learned position table caps the buffer; RoPE models
+        # extrapolate structurally and may generate past max_len
+        raise FriendlyError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the learned position table ({max_len}); build the model "
+            "with a larger max_len or pos_embedding='rope'"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused on the greedy path
+
+    buf = jnp.full((b, total), pad_id, jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+    def step(carry, _):
+        buf, pos, rng = carry
+        logits = graph.apply(variables, buf).astype(jnp.float32)
+        # logits for the token AT pos come from position pos-1
+        cur = jax.lax.dynamic_slice_in_dim(
+            logits, pos - 1, 1, axis=1
+        )[:, 0]  # (B, V) via dynamic index; pos is traced
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, cur / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(cur, axis=-1)
+        buf = jax.lax.dynamic_update_slice(
+            buf, nxt.astype(jnp.int32)[:, None], (0, pos)
+        )
+        return (buf, pos + 1, rng), None
+
+    (buf, _, _), _ = jax.lax.scan(
+        step, (buf, jnp.asarray(p, jnp.int32), rng), None,
+        length=max_new_tokens,
+    )
+    return buf

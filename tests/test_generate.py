@@ -1,0 +1,118 @@
+"""Autoregressive generation over the causal transformer family.
+
+The reference has no generative model (SURVEY §5); generate() is part of
+the long-context capability upgrade, so its tests are behavioral: a tiny
+LM overfit on a periodic stream must CONTINUE the period, greedy decode
+must be deterministic, and every attention configuration (window, GQA,
+RoPE) must decode through the same utility.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.models import build_model, generate
+
+PERIOD = 4  # token stream cycles 1,2,3,4,1,2,...
+
+
+def _train_lm(m, steps=60, seq=16):
+    ids = jnp.asarray(
+        (np.arange(seq)[None] % PERIOD) + 1, jnp.int32
+    )  # (1, seq)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    opt = optax.adam(5e-2)
+    st = opt.init(v)
+
+    def loss(p):
+        lg = m.apply(p, ids).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1], ids[:, 1:]
+        ).mean()
+
+    @jax.jit
+    def step(p, st):
+        g = jax.grad(loss)(p)
+        up, st = opt.update(g, st, p)
+        return optax.apply_updates(p, up), st
+
+    for _ in range(steps):
+        v, st = step(v, st)
+    return v, ids
+
+
+@pytest.mark.parametrize("config", [
+    {},                                            # plain learned-pos
+    {"window": 6},                                 # sliding window
+    {"pos_embedding": "rope", "kv_heads": 1},      # RoPE + MQA
+])
+def test_overfit_lm_continues_the_period(config):
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=32, **config)
+    v, ids = _train_lm(m)
+    prompt = ids[:, :8]
+    out = np.asarray(generate(m, v, prompt, max_new_tokens=8))
+    want = (np.arange(16) % PERIOD) + 1
+    np.testing.assert_array_equal(out[0], want)
+
+
+def test_greedy_is_deterministic_and_sampling_needs_rng():
+    m = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
+                    depth=1, max_len=24)
+    v, ids = _train_lm(m, steps=5)
+    prompt = ids[:, :4]
+    a = np.asarray(generate(m, v, prompt, max_new_tokens=6))
+    b = np.asarray(generate(m, v, prompt, max_new_tokens=6))
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(FriendlyError, match="rng"):
+        generate(m, v, prompt, max_new_tokens=2, temperature=0.7)
+    # sampling path runs and keeps the prompt intact
+    s = np.asarray(generate(m, v, prompt, max_new_tokens=6,
+                            temperature=0.7,
+                            rng=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(s[:, :4], np.asarray(prompt))
+
+
+def test_generate_guards():
+    m = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
+                    depth=1, max_len=8)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    prompt = jnp.zeros((1, 6), jnp.int32)
+    with pytest.raises(FriendlyError, match="position table"):
+        generate(m, v, prompt, max_new_tokens=4)  # 10 > max_len 8
+    with pytest.raises(FriendlyError, match=">= 1"):
+        generate(m, v, prompt, max_new_tokens=0)
+    bidir = build_model("transformer_lm", vocab_size=8, d_model=16,
+                        heads=2, depth=1, max_len=8, causal=False)
+    bv = bidir.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(FriendlyError, match="causal"):
+        generate(bidir, bv, prompt, max_new_tokens=1)
+
+
+def test_rope_generates_past_trained_max_len():
+    """RoPE has no position table: generation may run past max_len (the
+    structural-extrapolation property, impossible with learned pos)."""
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=16, pos_embedding="rope")
+    v, ids = _train_lm(m, seq=16)
+    out = np.asarray(generate(m, v, ids, max_new_tokens=8))  # 24 > 16
+    want = (np.arange(24) % PERIOD) + 1
+    np.testing.assert_array_equal(out[0], want)
+
+
+def test_generate_rejects_moe_and_negative_temperature():
+    m = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
+                    depth=1, max_len=16)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(FriendlyError, match="temperature"):
+        generate(m, v, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2,
+                 temperature=-0.5, rng=jax.random.PRNGKey(0))
+    moe = build_model("transformer_lm_moe", vocab_size=8, d_model=16,
+                      heads=2, depth=1, max_len=16, n_experts=2)
+    mv = moe.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(FriendlyError, match="MoE"):
+        generate(moe, mv, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2)
