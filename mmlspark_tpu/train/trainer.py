@@ -66,6 +66,13 @@ class TrainConfig:
     # trades ~33% more FLOPs for not keeping activations in HBM — the
     # standard lever when activation memory, not compute, caps batch size
     remat: bool = False
+    # accumulate gradients over K equal micro-batches inside one
+    # optimizer step (lax.scan over the split batch): the effective
+    # batch stays batch_size while activation memory drops to 1/K — the
+    # complementary lever to remat when memory caps the batch. Exact for
+    # mean losses over equal micro-batches (grads are averaged before
+    # the single optimizer update).
+    grad_accum: int = 1
     # weight on sown auxiliary losses (e.g. MoE load-balance, models/moe.py)
     moe_aux_weight: float = 1e-2
     # mesh: axis name -> size; None = all devices on the data axis
@@ -267,18 +274,71 @@ class SPMDTrainer:
             # activations in HBM
             fwd = jax.checkpoint(fwd)
 
+        accum = max(int(cfg.grad_accum), 1)
+        if accum > 1 and batch % (accum * n_data):
+            raise FriendlyError(
+                f"grad_accum={accum} needs the (data-axis rounded) batch "
+                f"size {batch} divisible by accum x data-axis size "
+                f"({accum * n_data})"
+            )
+
         def step_fn(params, rest, opt_state, bx, by, bmask):
-            def loss_fn(p):
-                variables = _merge_variables(p, rest)
-                out, updated = fwd(variables, bx, bmask)
-                loss = masked_loss(loss_kind, out, by, bmask)
+            def loss_fn(p, r, mx, my, mm):
+                variables = _merge_variables(p, r)
+                out, updated = fwd(variables, mx, mm)
+                loss = masked_loss(loss_kind, out, my, mm)
                 loss = loss + aux_w * _sown_aux_loss(updated)
                 _, new_rest = _split_variables(updated)
                 return loss, new_rest
 
-            (loss, new_rest), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+            if accum == 1:
+                (loss, new_rest), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, rest, bx, by, bmask)
+            else:
+                # micro-batch scan: grads sum in f32 param space, ONE
+                # optimizer update at the end — activations for only one
+                # micro-batch are ever live. Two exactness details:
+                # - STRIDED split (row i -> micro i % accum): each
+                #   device's contiguous data-axis shard feeds every
+                #   micro-batch locally (a contiguous split would move
+                #   whole micro-batches across the mesh every step), and
+                #   the padded tail spreads over micro-batches;
+                # - WEIGHTED accumulation: each micro contributes its
+                #   masked loss SUM and mask count, normalized once at
+                #   the end — uniform averaging of per-micro means would
+                #   shrink the step by up to accum when padding
+                #   concentrates in some micro-batches (masked_loss
+                #   normalizes by its own batch's count).
+                split = lambda t: t.reshape(  # noqa: E731
+                    t.shape[0] // accum, accum, *t.shape[1:]
+                ).swapaxes(0, 1)
+
+                def sum_loss_fn(p, r, mx, my, mm):
+                    l, r2 = loss_fn(p, r, mx, my, mm)
+                    cnt = jnp.sum(mm.astype(jnp.float32))
+                    return l * jnp.maximum(cnt, 1.0), (r2, cnt)
+
+                def body(carry, xs):
+                    gsum, lsum, csum, r = carry
+                    (ls, (r, cnt)), g = jax.value_and_grad(
+                        sum_loss_fn, has_aux=True
+                    )(params, r, *xs)
+                    gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                    return (gsum, lsum + ls, csum + cnt, r), None
+
+                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                f0 = jnp.asarray(0.0, jnp.float32)
+                (gsum, lsum, csum, new_rest), _ = jax.lax.scan(
+                    body,
+                    (zero, f0, f0, rest),
+                    (split(bx), split(by), split(bmask)),
+                )
+                denom = jnp.maximum(csum, 1.0)
+                grads = jax.tree_util.tree_map(
+                    lambda t: t / denom, gsum
+                )
+                loss = lsum / denom
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_params, new_rest, new_opt, loss

@@ -3,6 +3,7 @@ sync across shards is correct, checkpoint/resume works (reference analog:
 ValidateCntkTrain.scala e2e tiny-epoch training)."""
 
 import jax
+import pytest
 import numpy as np
 
 from mmlspark_tpu.data.dataset import Dataset
@@ -200,3 +201,78 @@ def test_remat_is_semantics_preserving():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_grad_accum_matches_full_batch_sgd():
+    """grad_accum=K averages micro-batch gradients before ONE optimizer
+    update, so SGD training must reproduce the no-accumulation params up
+    to compute precision. The model family computes in bf16, so the
+    micro vs full forward differs at bf16 epsilon (2^-8 relative) per
+    step — tolerances are bf16-scale, not f32-exact."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+
+    def run(accum):
+        graph = build_model("mlp", num_outputs=2, hidden=(16,))
+        tr = SPMDTrainer(
+            graph,
+            TrainConfig(epochs=2, batch_size=16, learning_rate=0.1,
+                        optimizer="sgd", grad_accum=accum, shuffle=False,
+                        log_every=100),
+        )
+        v = tr.train(x, y)
+        return jax.tree_util.tree_leaves(v), [
+            h["loss"] for h in tr.history if "loss" in h
+        ]
+
+    p1, l1 = run(1)
+    p2, l2 = run(2)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-2)
+    np.testing.assert_allclose(l1, l2, atol=2e-3, rtol=2e-2)
+
+
+def test_grad_accum_exact_on_padded_tail():
+    """The tail batch (4 real rows + 12 padding at n=20, batch=16) must
+    produce the SAME update under accumulation: micro losses accumulate
+    as weighted sums normalized once, so padding concentrated in some
+    micro-batches cannot shrink the step."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(20, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+
+    def run(accum):
+        graph = build_model("mlp", num_outputs=2, hidden=(16,))
+        tr = SPMDTrainer(
+            graph,
+            TrainConfig(epochs=1, batch_size=16, learning_rate=0.1,
+                        optimizer="sgd", grad_accum=accum, shuffle=False,
+                        log_every=100),
+        )
+        v = tr.train(x, y)
+        return jax.tree_util.tree_leaves(v)
+
+    for a, b in zip(run(1), run(2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-2)
+
+
+def test_grad_accum_divisibility_guard():
+    from mmlspark_tpu.core.exceptions import FriendlyError
+    from mmlspark_tpu.models import build_model
+
+    graph = build_model("mlp", num_outputs=2, hidden=(8,))
+    x = np.zeros((12, 4), np.float32)
+    y = np.zeros((12,), np.int32)
+    tr = SPMDTrainer(
+        graph,
+        TrainConfig(epochs=1, batch_size=12, grad_accum=5, shuffle=False),
+    )
+    with pytest.raises(FriendlyError, match="grad_accum"):
+        tr.train(x, y)
