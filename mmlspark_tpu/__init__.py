@@ -25,7 +25,7 @@ Layer map (mirrors SURVEY.md):
 - :mod:`mmlspark_tpu.utils`    — small shared utilities
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from mmlspark_tpu.core.stage import (  # noqa: F401
     Estimator,
