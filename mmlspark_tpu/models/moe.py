@@ -88,13 +88,17 @@ class MoEBlock(nn.Module):
     capacity_factor: float
     attn_impl: str = AUTO
     dtype: Any = jnp.bfloat16
+    window: int | None = None
+    kv_heads: int | None = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(self.heads, self.head_dim, self.causal,
                               resolve_attn_impl(self.attn_impl),
-                              mesh=None, dtype=self.dtype,
+                              window=self.window, kv_heads=self.kv_heads,
+                              rope=self.rope, mesh=None, dtype=self.dtype,
                               name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = MoEFFN(self.n_experts, self.d_ff, self.capacity_factor,
@@ -114,11 +118,22 @@ def transformer_lm_moe(
     causal: bool = True,
     capacity_factor: float = 1.25,
     attn_impl: str = AUTO,
+    window: int | None = None,
+    kv_heads: int | None = None,
+    pos_embedding: str = "learned",
     mesh: Any = None,
 ) -> NamedGraph:
-    """Decoder-only switch-MoE LM; every block's FFN is expert-routed."""
+    """Decoder-only switch-MoE LM; every block's FFN is expert-routed.
+    The attention feature set (window / kv_heads / pos_embedding) is the
+    same as transformer_lm's."""
     if d_model % heads:
         raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
+    from mmlspark_tpu.models.transformer import validate_attention_features
+
+    rope = validate_attention_features(
+        heads=heads, head_dim=d_model // heads, causal=causal,
+        window=window, kv_heads=kv_heads, pos_embedding=pos_embedding,
+    )
     from mmlspark_tpu.models.transformer import ATTN_IMPLS
 
     if attn_impl not in ATTN_IMPLS:
@@ -128,14 +143,16 @@ def transformer_lm_moe(
     validate_experts(n_experts, mesh)
     d_ff = d_ff or 4 * d_model
     blocks: list[tuple[str, Any]] = [
-        ("embed", TokenPosEmbed(vocab_size, d_model, max_len))
+        ("embed", TokenPosEmbed(vocab_size, d_model, max_len,
+                                learned_pos=not rope))
     ]
     for i in range(depth):
         blocks.append(
             (
                 f"block{i}",
                 MoEBlock(heads, d_model // heads, n_experts, d_ff, causal,
-                         capacity_factor, attn_impl),
+                         capacity_factor, attn_impl, window=window,
+                         kv_heads=kv_heads, rope=rope),
             )
         )
     blocks.append((FINAL_NODE, LMHead(vocab_size)))
@@ -147,5 +164,8 @@ def transformer_lm_moe(
             "vocab_size": vocab_size,
             "n_experts": n_experts,
             "causal": causal,
+            "window": window,
+            "kv_heads": kv_heads,
+            "pos_embedding": pos_embedding,
         },
     )

@@ -178,6 +178,38 @@ class LMHead(nn.Module):
         return x.astype(jnp.float32)
 
 
+def validate_attention_features(*, heads: int, head_dim: int,
+                                causal: bool, window: int | None,
+                                kv_heads: int | None,
+                                pos_embedding: str) -> bool:
+    """Shared build-time validation for the attention feature set
+    (transformer_lm AND transformer_lm_moe use the same rules); returns
+    whether RoPE is enabled."""
+    if window is not None:
+        if not causal:
+            raise ParamError(
+                "window (causal sliding-window attention) requires "
+                "causal=True"
+            )
+        if int(window) < 1:
+            raise ParamError(f"window must be >= 1, got {window}")
+    if kv_heads is not None and (kv_heads < 1 or heads % kv_heads):
+        raise ParamError(
+            f"kv_heads ({kv_heads}) must be >= 1 and divide heads "
+            f"({heads})"
+        )
+    if pos_embedding not in ("learned", "rope"):
+        raise ParamError(
+            f"pos_embedding must be 'learned' or 'rope', got "
+            f"'{pos_embedding}'"
+        )
+    if pos_embedding == "rope" and head_dim % 2:
+        raise ParamError(
+            f"RoPE needs an even head_dim, got {head_dim}"
+        )
+    return pos_embedding == "rope"
+
+
 @register_model("transformer_lm")
 def transformer_lm(
     vocab_size: int = 1024,
@@ -199,32 +231,10 @@ def transformer_lm(
     flash kernel's causal sliding window (O(S·W) attention work)."""
     if d_model % heads:
         raise ParamError(f"d_model {d_model} not divisible by heads {heads}")
-    if window is not None:
-        if not causal:
-            raise ParamError(
-                "window (causal sliding-window attention) requires "
-                "causal=True"
-            )
-        if int(window) < 1:
-            raise ParamError(f"window must be >= 1, got {window}")
-    if kv_heads is not None and (
-        kv_heads < 1 or heads % kv_heads
-    ):
-        raise ParamError(
-            f"kv_heads ({kv_heads}) must be >= 1 and divide heads "
-            f"({heads})"
-        )
-    if pos_embedding not in ("learned", "rope"):
-        raise ParamError(
-            f"pos_embedding must be 'learned' or 'rope', got "
-            f"'{pos_embedding}'"
-        )
-    if pos_embedding == "rope" and (d_model // heads) % 2:
-        raise ParamError(
-            f"RoPE needs an even head_dim; d_model//heads = "
-            f"{d_model // heads}"
-        )
-    rope = pos_embedding == "rope"
+    rope = validate_attention_features(
+        heads=heads, head_dim=d_model // heads, causal=causal,
+        window=window, kv_heads=kv_heads, pos_embedding=pos_embedding,
+    )
     if attn_impl not in ATTN_IMPLS:
         raise ParamError(
             f"unknown attn_impl '{attn_impl}'; one of {ATTN_IMPLS}"

@@ -222,3 +222,28 @@ def test_moe_ffn_prime_token_count_keeps_group_size():
          for i, c in enumerate(chosen)]
     ).reshape(b, t, d)
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3, atol=1e-4)
+
+
+def test_moe_attention_features_uniform_with_dense_lm():
+    """The MoE family carries the same attention feature set: a
+    window + MQA + RoPE MoE LM builds, runs forward+grad, and records
+    the config in extra (no learned position table under rope)."""
+    from mmlspark_tpu.models import build_model
+
+    m = build_model("transformer_lm_moe", vocab_size=32, d_model=16,
+                    heads=4, depth=1, n_experts=2, max_len=16,
+                    window=6, kv_heads=1, pos_embedding="rope")
+    assert m.extra["window"] == 6 and m.extra["kv_heads"] == 1
+    x = jnp.asarray(np.arange(16)[None] % 32, jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert "pos" not in v["embed"]["params"]
+    loss = jax.jit(lambda p: jnp.mean(
+        m.apply(p, x).astype(jnp.float32) ** 2))
+    assert float(loss(v)) > 0
+    g = jax.jit(jax.grad(loss))(v)
+    assert jax.tree_util.tree_reduce(
+        lambda a, b: a + float(jnp.sum(jnp.abs(b))), g, 0.0) > 0
+
+    with pytest.raises(ParamError, match="kv_heads"):
+        build_model("transformer_lm_moe", vocab_size=32, d_model=16,
+                    heads=4, depth=1, n_experts=2, max_len=16, kv_heads=3)
