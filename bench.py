@@ -80,6 +80,7 @@ _GROUPS = {
     "train": ("train_epoch_seconds",),
     "trees": ("gbt_fit_seconds",),
     "flash": ("flash_fwd_ms",),
+    "flash_long": ("flash_long",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -562,6 +563,21 @@ def bench_trees(jax) -> dict:
     }
 
 
+def _xla_attention_f32(jax, jnp, d):
+    """The einsum-softmax attention reference used by BOTH flash groups:
+    scores and the PV matmul in f32 (output downcast by callers as
+    needed). One definition so the short- and long-context speedup
+    ratios are measured against the identical baseline."""
+    def attn(q, k, v):
+        qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+        p = jax.nn.softmax(
+            jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * (d ** -0.5), axis=-1
+        )
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+
+    return attn
+
+
 def bench_flash(jax, jnp) -> dict:
     """Pallas flash attention vs the XLA einsum-softmax path — the hot op
     the reference never had (SURVEY §5: no attention exists there). On
@@ -581,12 +597,7 @@ def bench_flash(jax, jnp) -> dict:
         for _ in range(3)
     )
 
-    def xla_attn(q, k, v):
-        qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
-        p = jax.nn.softmax(
-            jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * (d ** -0.5), axis=-1
-        )
-        return jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+    xla_attn = _xla_attention_f32(jax, jnp, d)
 
     flash = jax.jit(
         lambda q, k, v: flash_attention(
@@ -643,40 +654,62 @@ def bench_flash(jax, jnp) -> dict:
         "flash_timing": timing,
         "flash_compiled": bool(full),  # False = interpreter-mode smoke
     }
-    if full:
-        # long-context leg: at S=8192 the XLA path streams a ~2.1 GB
-        # (S, S) f32 score tensor through HBM per step while the fused
-        # kernel stays O(S·d) in VMEM — the regime the kernel exists
-        # for, recorded in the driver's own artifact. Flash lands first
-        # so an XLA-side OOM (itself evidence for fusion) can't erase it.
-        try:
-            sl = 8192
-            ql, kl, vl = (
-                jnp.asarray(rng.normal(size=(1, sl, h, d)), jnp.bfloat16)
-                for _ in range(3)
-            )
-            t_lf, fb_lf = _chained_op_seconds(
-                jax, jnp, flash_step, ql, kl, vl,
-            )
-            res["flash_long_s8192_fwd_ms"] = round(t_lf * 1e3, 3)
-            res["flash_long_s8192_noise_fallback"] = fb_lf
-            try:
-                t_lx, fb_lx = _chained_op_seconds(
-                    jax, jnp, xla_step, ql, kl, vl,
-                )
-                res["flash_long_s8192_xla_fwd_ms"] = round(t_lx * 1e3, 3)
-                res["flash_long_s8192_vs_xla_speedup"] = round(
-                    t_lx / t_lf, 3
-                )
-                res["flash_long_s8192_noise_fallback"] = fb_lf or fb_lx
-            except Exception as e:  # noqa: BLE001
-                res["flash_long_s8192_xla_error"] = (
-                    f"{type(e).__name__}: {str(e)[:160]}"
-                )
-        except Exception as e:  # noqa: BLE001 — leg is additive
-            res["flash_long_s8192_error"] = (
-                f"{type(e).__name__}: {str(e)[:160]}"
-            )
+    return res
+
+
+def bench_flash_long(jax, jnp) -> dict:
+    """Long-context flash leg, its OWN group and the LAST one in the
+    sweep: at S=8192 the XLA path streams a ~2.1 GB (S, S) f32 score
+    tensor through HBM per step while the fused kernel stays O(S·d) in
+    VMEM — the regime the kernel exists for. The big chained compiles
+    over the relay are also the likeliest phase to hang a wedging
+    tunnel, so this group must run after everything else: a hang here
+    costs nothing but itself. Flash lands in the scratch before the XLA
+    comparison so an XLA-side OOM (itself evidence for fusion) can't
+    erase it."""
+    from mmlspark_tpu.ops.flash_attention import flash_attention
+
+    if not _full_scale(jax):
+        return {"flash_long": "cpu_smoke_skipped"}
+
+    sl, h, d = 8192, 8, 64
+    rng = np.random.default_rng(4)
+    ql, kl, vl = (
+        jnp.asarray(rng.normal(size=(1, sl, h, d)), jnp.bfloat16)
+        for _ in range(3)
+    )
+
+    xla_attn = _xla_attention_f32(jax, jnp, d)
+    xla_step = lambda qq, k, v: xla_attn(  # noqa: E731
+        qq, k, v
+    ).astype(qq.dtype)
+
+    res: dict = {}
+    t_lf, fb_lf = _chained_op_seconds(
+        jax, jnp,
+        lambda qq, k, v: flash_attention(qq, k, v, interpret=False),
+        ql, kl, vl,
+    )
+    res["flash_long_s8192_fwd_ms"] = round(t_lf * 1e3, 3)
+    res["flash_long_s8192_noise_fallback"] = fb_lf
+    # persist the flash fields WITHOUT the group's done-marker: a hang
+    # in the XLA side keeps the evidence but leaves the group
+    # incomplete, so a retry re-runs it (and the final line lists
+    # flash_long under missing_metrics instead of silently omitting
+    # the comparison)
+    _scratch_merge(res)
+    try:
+        t_lx, fb_lx = _chained_op_seconds(
+            jax, jnp, xla_step, ql, kl, vl,
+        )
+        res["flash_long_s8192_xla_fwd_ms"] = round(t_lx * 1e3, 3)
+        res["flash_long_s8192_vs_xla_speedup"] = round(t_lx / t_lf, 3)
+        res["flash_long_s8192_noise_fallback"] = fb_lf or fb_lx
+    except Exception as e:  # noqa: BLE001 — leg is additive
+        res["flash_long_s8192_xla_error"] = (
+            f"{type(e).__name__}: {str(e)[:160]}"
+        )
+    res["flash_long"] = "tpu"  # done-marker only once the group finished
     return res
 
 
@@ -812,11 +845,11 @@ def run(attempt: int) -> dict:
 
     # value-per-second order (the r4 run proved the tunnel can wedge
     # MID-SWEEP, so the headline and MFU target go first), refined by
-    # measured r4 group walls: the
-    # cheap train/trees groups (~25 s on TPU combined) run BEFORE flash —
-    # the flash group's chained compiles over the relay are the likeliest
-    # to hang a wedging tunnel, and must not starve the cheap groups —
-    # and the slow stage sweep stays last
+    # measured r4 group walls: the cheap train/trees groups (~25 s on
+    # TPU combined) run BEFORE flash, and flash_long — whose S=8192
+    # chained compiles over the relay are the likeliest phase to hang a
+    # wedging tunnel — runs DEAD LAST, after even the slow stage sweep,
+    # so a hang there costs nothing but itself
     runners = {
         "inference": lambda: bench_inference(jax, jnp, *flagship()),
         "resnet50": lambda: bench_resnet50(jax, jnp),
@@ -824,6 +857,7 @@ def run(attempt: int) -> dict:
         "trees": lambda: bench_trees(jax),
         "flash": lambda: bench_flash(jax, jnp),
         "stage": lambda: bench_stage_inference(jax, *flagship()),
+        "flash_long": lambda: bench_flash_long(jax, jnp),
     }
     # MMLTPU_BENCH_GROUPS=resnet50,inference runs a subset — lets a
     # short-lived healthy tunnel spend its minutes on the headline
@@ -839,7 +873,7 @@ def run(attempt: int) -> dict:
             )
         runners = {g: fn for g, fn in runners.items() if g in wanted}
     errors: dict[str, str] = {}
-    # generous: six groups with batch/depth/weight sweeps compile ~15+
+    # generous: seven groups with batch/depth/weight sweeps compile ~20
     # programs at 20-40s each on the relay before any timing starts
     metric_wd = _watchdog(
         float(os.environ.get("MMLTPU_BENCH_METRIC_TIMEOUT_S", "2400")),
