@@ -41,7 +41,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 import numpy as np
 
@@ -88,15 +87,6 @@ def _probe(timeout_s: float = 90.0) -> str:
         print(f"no TPU backend: {r.stdout.strip()} {r.stderr.strip()[-200:]}")
         raise SystemExit(2)
     return r.stdout.strip()
-
-
-def _timed_best(fn, trials: int = 3) -> float:
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        np.asarray(fn())  # forced host fetch = sync point
-        best = min(best, time.perf_counter() - t0)
-    return best
 
 
 def main() -> None:
