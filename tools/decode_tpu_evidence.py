@@ -1,0 +1,185 @@
+"""Prove KV-cache decode fast on REAL TPU (VERDICT r4 next #3).
+
+Runs ``generate()`` both ways — KV-cache decode (default) and the O(T²)
+full-recompute oracle — on the chip, jitted end-to-end (prefill + scan
+in ONE program, so the axon relay's per-dispatch latency is paid once
+per call, not per token):
+
+1. numerics: the cached prefill's last-position logits must match the
+   standard full forward scale-normalized (the real parity check), and
+   the greedy token streams must agree at >= 95% — NOT bit-exact:
+   weights here are random init, so vocab-sized logit gaps sit near
+   bf16 noise and a single reduction-order tie-flip diverges every
+   later token; the trained-model unit test is where exact equality is
+   asserted;
+2. timing: per-token cost from the DIFFERENCE of two generation lengths
+   (N=64 vs N=256) for each path — fixed costs (prefill, dispatch,
+   host sync) cancel, leaving the marginal cost of one decode step.
+   The headline is tokens/sec for the cache path and the speedup ratio;
+   VERDICT r4 expects >= 5x at N=256 on the dense model.
+
+Both paths run ``attn_impl='dense'`` so the comparison isolates the
+cache machinery, not flash-vs-dense kernel differences.
+
+Writes ``DECODE_TPU_EVIDENCE.json`` at the repo root for committing.
+A wedged tunnel is detected with a killable subprocess probe first, so
+the script fails fast with exit 2 instead of hanging.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "DECODE_TPU_EVIDENCE.json")
+sys.path.insert(0, REPO)
+
+# serving-ish model: big enough that a step is real matmul work, small
+# enough that the recompute leg's 256 full forwards stay measurable
+VOCAB, D_MODEL, HEADS, DEPTH = 8192, 512, 8, 8
+B, P = 8, 64
+N_SHORT, N_LONG = 64, 256
+
+
+def _probe(timeout_s: float = 90.0) -> str:
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "assert 'TPU' in d.device_kind, d.device_kind; "
+        "print(d.device_kind)"
+    )
+    r = subprocess.run([sys.executable, "-c", code],
+                       timeout=timeout_s, capture_output=True, text=True)
+    if r.returncode != 0:
+        print("probe failed:", (r.stdout + r.stderr)[-400:], file=sys.stderr)
+        sys.exit(2)
+    return r.stdout.strip()
+
+
+def _timed_best(fn, trials: int = 3) -> float:
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        np.asarray(fn())  # host fetch forces completion through the relay
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    try:
+        kind = _probe()
+    except subprocess.TimeoutExpired:
+        print("probe hung (tunnel wedged)", file=sys.stderr)
+        sys.exit(2)
+    print(f"tunnel healthy: {kind}")
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model, generate
+
+    graph = build_model(
+        "transformer_lm", vocab_size=VOCAB, d_model=D_MODEL, heads=HEADS,
+        depth=DEPTH, max_len=P + N_LONG, attn_impl="dense",
+    )
+    rng = jax.random.PRNGKey(0)
+    variables = graph.init(rng, jnp.zeros((1, P), jnp.int32))
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, VOCAB, size=(B, P)), jnp.int32
+    )
+
+    # compile each (length, path) program ONCE and reuse it for both the
+    # numerics check and the timing trials — relay compiles cost 20-40 s
+    # each and the healthy tunnel window is ~20 min total
+    jitted = {
+        (n, kv): jax.jit(
+            lambda pr, n=n, kv=kv: generate(
+                graph, variables, pr, n, kv_cache=kv
+            )
+        )
+        for n in (N_SHORT, N_LONG)
+        for kv in (True, False)
+    }
+
+    evidence: dict = {
+        "device_kind": kind,
+        "model": {"vocab": VOCAB, "d_model": D_MODEL, "heads": HEADS,
+                  "depth": DEPTH, "batch": B, "prompt": P},
+        "method": (
+            "whole generate() jitted (prefill + lax.scan in one program); "
+            "per-token seconds = (t(N=256) - t(N=64)) / 192, best of 3 "
+            "host-fetch-synced trials per length — fixed dispatch/prefill "
+            "costs cancel in the difference"
+        ),
+    }
+
+    # -- numerics ----------------------------------------------------------
+    # logits parity at the prefill boundary: cached prefill's last
+    # position vs the standard full forward, scale-normalized (the same
+    # gate the flash evidence uses — TPU precision is relative to
+    # magnitude)
+    from mmlspark_tpu.models.generate import _cached_apply, init_cache
+
+    cache0 = init_cache(graph, variables, B, P + N_SHORT)
+    cached_logits, _ = jax.jit(
+        lambda pr: _cached_apply(graph, variables, pr, cache0, 0)
+    )(prompt)
+    full_logits = jax.jit(lambda pr: graph.apply(variables, pr))(prompt)
+    got = np.asarray(cached_logits[:, -1], np.float32)
+    want = np.asarray(full_logits[:, -1], np.float32)
+    scaled_err = float(
+        np.abs(got - want).max() / max(1.0, np.abs(want).max())
+    )
+    # greedy streams: random-init logit gaps sit near bf16 noise, so a
+    # reduction-order tie can flip one argmax and diverge the suffix —
+    # gate on agreement rate, assert exactness only up to first flip
+    kv_short = np.asarray(jitted[(N_SHORT, True)](prompt))
+    rc_short = np.asarray(jitted[(N_SHORT, False)](prompt))
+    agree = float((kv_short == rc_short).mean())
+    evidence["numerics"] = {
+        "prefill_logits_scaled_err": scaled_err,
+        "greedy_token_agreement": round(agree, 4),
+        "n_tokens_compared": int(kv_short.size),
+        "note": "random-init weights; exact equality on trained models "
+                "is asserted by tests/test_generate.py",
+    }
+    print(f"numerics: prefill scaled err {scaled_err:.2e}, "
+          f"greedy agreement {agree:.3f}")
+    assert scaled_err <= 1e-2, ("prefill logits diverge", scaled_err)
+    assert agree >= 0.95, ("greedy token agreement too low", agree)
+
+    # -- timing ------------------------------------------------------------
+    timing: dict = {}
+    for name, kv in (("kv_cache", True), ("recompute", False)):
+        f_short, f_long = jitted[(N_SHORT, kv)], jitted[(N_LONG, kv)]
+        f_short(prompt), f_long(prompt)  # warm (short ones already compiled)
+        t_short = _timed_best(lambda: f_short(prompt))
+        t_long = _timed_best(lambda: f_long(prompt))
+        per_tok = max(t_long - t_short, 1e-9) / (N_LONG - N_SHORT)
+        timing[name] = {
+            "t_n64_s": round(t_short, 4),
+            "t_n256_s": round(t_long, 4),
+            "per_token_ms": round(per_tok * 1e3, 4),
+            "tokens_per_sec_per_seq": round(1.0 / per_tok, 1),
+            "tokens_per_sec_batch": round(B / per_tok, 1),
+        }
+        print(f"{name}: {per_tok*1e3:.3f} ms/token "
+              f"({B/per_tok:.0f} tok/s at batch {B})")
+    speedup = (timing["recompute"]["per_token_ms"]
+               / timing["kv_cache"]["per_token_ms"])
+    timing["kv_vs_recompute_speedup"] = round(speedup, 2)
+    evidence["timing"] = timing
+    print(f"kv-cache speedup vs recompute at N={N_LONG}: {speedup:.1f}x")
+
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(evidence, f, indent=1)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# Round-5 TPU evidence orchestrator. Fired by the detached pounce loop the
+# moment a tunnel probe succeeds; safe to fire repeatedly — each step is
+# guarded by a marker file in /tmp/r5m/ and re-runs only until its "done"
+# condition (a TPU-backed artifact committed) holds.
+#
+# Runs every step from a throwaway worktree at current HEAD so an
+# in-session half-edited working tree can never crash a tunnel window.
+# Artifacts are copied back to /root/repo and committed under a git lock.
+#
+# Priorities (VERDICT r4 "Next round"): flash-vs-XLA on-chip timings (#2),
+# trees on TPU (#5), int8 serving shapes (#4), feed-overhead bound (#7) —
+# the latter two ride the bench groups added this round.
+set -u
+REPO=/root/repo
+WT=/tmp/r5wt
+M=/tmp/r5m
+mkdir -p "$M"
+export PYTHONPATH="$WT:/root/.axon_site"
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+commit_file() { # commit_file <repo-relative-path> <message>
+  # pathspec commit: anything the interactive session happens to have
+  # staged in /root/repo must NOT ride along under this message
+  (
+    flock -w 120 9 || exit 1
+    cd "$REPO" && git add "$1" && git commit -m "$2" -- "$1"
+  ) 9>/tmp/r5_git.lock
+}
+
+fresh_worktree() {
+  cd "$REPO" || exit 1
+  git worktree remove --force "$WT" 2>/dev/null
+  rm -rf "$WT"
+  git worktree add --detach "$WT" HEAD >/dev/null || exit 1
+}
+
+probe_ok() {
+  timeout 55 python -c \
+    "import jax; assert any('TPU' in d.device_kind for d in jax.devices())" \
+    2>/dev/null
+}
+
+fresh_worktree
+log "evidence run starts from $(git -C "$WT" rev-parse --short HEAD)"
+
+# -- step 1: flash-vs-XLA chained on-chip timings (VERDICT #2) -------------
+if [ ! -f "$M/flash_ev.done" ]; then
+  log "step flash_ev: tools/flash_tpu_evidence.py"
+  if (cd "$WT" && timeout 1800 python tools/flash_tpu_evidence.py); then
+    cp "$WT/FLASH_TPU_EVIDENCE.json" "$REPO/FLASH_TPU_EVIDENCE.json"
+    commit_file FLASH_TPU_EVIDENCE.json \
+      "Refresh FLASH_TPU_EVIDENCE.json: on-chip chained flash-vs-XLA timings" \
+      && touch "$M/flash_ev.done" && log "flash_ev DONE"
+  else
+    log "flash_ev failed (rc=$?)"
+  fi
+fi
+
+# -- step 2: full bench with resumable scratch (VERDICT #1/#4/#5/#7) -------
+# One scratch file across windows: a wedge mid-sweep keeps what landed and
+# the next window completes only the missing groups. Done only when the
+# headline landed AND trees+flash ran on the chip (the two groups r4 never
+# recorded on TPU).
+if [ ! -f "$M/bench.done" ]; then
+  probe_ok || { log "tunnel gone before bench; stop"; exit 0; }
+  log "step bench: full sweep (resumable scratch)"
+  # cross-window resume hygiene: groups a previous window's CPU-smoke
+  # fallback landed read as "done" to the scratch skip logic — strip
+  # them so this window re-runs them on the chip, keeping TPU-landed
+  # groups
+  if [ -f /tmp/bench_r5_scratch.json ]; then
+    (cd "$WT" && python - <<'PY'
+import json
+from bench import _GROUPS
+path = "/tmp/bench_r5_scratch.json"
+s = json.load(open(path))
+gb = s.get("group_backends", {})
+for g, keys in _GROUPS.items():
+    if gb.get(g) and gb[g] != "tpu":
+        for k in keys:
+            s.pop(k, None)
+        gb.pop(g, None)
+        s.get("group_seconds", {}).pop(g, None)
+s["group_backends"] = gb
+for transient in ("wall_skipped", "fallback_reason", "probe",
+                  "group_errors"):
+    s.pop(transient, None)
+json.dump(s, open(path, "w"))
+print("scratch resume: tpu-landed groups kept:", sorted(gb))
+PY
+    )
+  fi
+  (cd "$WT" && \
+    MMLTPU_BENCH_SCRATCH=/tmp/bench_r5_scratch.json \
+    MMLTPU_BENCH_PROBE_WINDOW_S=90 \
+    MMLTPU_BENCH_WALL_S=3300 \
+    timeout 3600 python bench.py | tail -n 1 > /tmp/bench_r5_line.json)
+  python - <<'PY'
+import json, sys
+line = json.load(open("/tmp/bench_r5_line.json"))
+gb = line.get("group_backends", {})
+print("bench landed:", {k: line.get(k) for k in
+      ("value", "scale", "device_kind", "resnet50_mfu", "gbt_fit_seconds",
+       "flash_vs_xla_speedup", "error_class")})
+print("group_backends:", gb)
+if line.get("value") is None:
+    sys.exit("no headline value - not recording")
+ok = all(gb.get(g) == "tpu" for g in ("inference", "trees", "flash"))
+sys.exit(0 if ok else 3)  # 3: recorded but incomplete TPU coverage
+PY
+  rc=$?
+  if [ "$rc" -le 3 ] && [ "$rc" -ne 1 ]; then
+    cp /tmp/bench_r5_line.json "$REPO/BENCH_LOCAL_r5.json"
+    commit_file BENCH_LOCAL_r5.json \
+      "Record in-session TPU bench artifact BENCH_LOCAL_r5.json"
+    [ "$rc" -eq 0 ] && touch "$M/bench.done" && log "bench DONE (full TPU)"
+    [ "$rc" -eq 3 ] && log "bench recorded but trees/flash not on TPU yet"
+  else
+    log "bench produced no headline (rc=$rc)"
+  fi
+fi
+
+# -- step 3: decode tokens/sec evidence (KV cache, VERDICT #3) -------------
+if [ ! -f "$M/decode_ev.done" ] && [ -f "$WT/tools/decode_tpu_evidence.py" ]; then
+  probe_ok || { log "tunnel gone before decode_ev; stop"; exit 0; }
+  log "step decode_ev: tools/decode_tpu_evidence.py"
+  if (cd "$WT" && timeout 1200 python tools/decode_tpu_evidence.py); then
+    cp "$WT/DECODE_TPU_EVIDENCE.json" "$REPO/DECODE_TPU_EVIDENCE.json"
+    commit_file DECODE_TPU_EVIDENCE.json \
+      "Record on-chip KV-cache decode tokens/sec evidence" \
+      && touch "$M/decode_ev.done" && log "decode_ev DONE"
+  else
+    log "decode_ev failed (rc=$?)"
+  fi
+fi
+
+log "evidence run ends"
