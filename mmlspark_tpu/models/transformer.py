@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from mmlspark_tpu.core.exceptions import ParamError
@@ -55,17 +56,24 @@ class TokenPosEmbed(nn.Module):
     learned_pos: bool = True  # False: tokens only (RoPE in attention)
 
     @nn.compact
-    def __call__(self, ids):
-        # ids: (B, T) int
+    def __call__(self, ids, pos=None):
+        # ids: (B, T) int; ``pos`` (traced scalar) offsets the position
+        # table for cached decode, where T is the step width not the
+        # absolute position
         tok = nn.Embed(self.vocab_size, self.d_model,
                        param_dtype=jnp.float32, name="token")(ids)
         if not self.learned_pos:
             return tok
-        pos = self.param(
+        table = self.param(
             "pos", nn.initializers.normal(0.02),
             (self.max_len, self.d_model), jnp.float32,
         )
-        return tok + pos[None, : ids.shape[1]]
+        if pos is None:
+            return tok + table[None, : ids.shape[1]]
+        rows = jax.lax.dynamic_slice(
+            table, (pos, 0), (ids.shape[1], self.d_model)
+        )
+        return tok + rows[None]
 
 
 class SelfAttention(nn.Module):
@@ -80,7 +88,7 @@ class SelfAttention(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, pos=None):
         b, t, _ = x.shape
         h, d = self.heads, self.head_dim
         hk = self.kv_heads or h
@@ -96,19 +104,38 @@ class SelfAttention(nn.Module):
         if self.rope:
             from mmlspark_tpu.ops.rope import apply_rope
 
-            q = apply_rope(q)
-            k = apply_rope(k)
+            positions = None if cache is None else pos + jnp.arange(t)
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
         if self.attn_impl not in ATTN_IMPLS:
             raise ParamError(
                 f"unknown attn_impl '{self.attn_impl}'; one of {ATTN_IMPLS}"
             )
         impl = resolve_attn_impl(self.attn_impl)
-        if hk != h and impl in (RING, ULYSSES) and self.mesh is not None:
-            raise ParamError(
-                "kv_heads (grouped-query attention) is supported by the "
-                f"dense and flash paths; attn_impl resolved to '{impl}'"
+        new_cache = None
+        if cache is not None:
+            # KV-cache decode (models/generate.py): the preallocated
+            # (B, total, hk, d) buffers take this step's K/V at ``pos``
+            # and the single fused dense step attends q against the whole
+            # buffer — unwritten future positions fall to the causal mask
+            # (q_offset=pos), so one static-shape program serves both
+            # prefill (t = prompt len, pos = 0) and decode (t = 1). The
+            # impl dispatch above is a *training/scoring* choice; a
+            # one-query read of HBM-resident K/V is bandwidth-bound and
+            # gains nothing from the flash/ring decompositions.
+            if not self.causal:
+                raise ParamError("cache decode requires causal=True")
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, pos, 0, 0)
             )
-        if impl == FLASH:
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, pos, 0, 0)
+            )
+            new_cache = (ck, cv)
+            o = dense_attention(q, ck, cv, causal=True,
+                                window=self.window, q_offset=pos)
+        elif impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 
             o = flash_attention(q, k, v, causal=self.causal,
@@ -131,10 +158,11 @@ class SelfAttention(nn.Module):
                                   window=self.window)
         else:  # unreachable: impl validated + resolved above
             raise ParamError(f"unhandled attn_impl '{impl}'")
-        return nn.Dense(x.shape[-1], dtype=self.dtype,
-                        param_dtype=jnp.float32, name="attn_out")(
+        out = nn.Dense(x.shape[-1], dtype=self.dtype,
+                       param_dtype=jnp.float32, name="attn_out")(
             o.reshape(b, t, h * d)
         )
+        return out if new_cache is None else (out, new_cache)
 
 
 class Block(nn.Module):
@@ -150,20 +178,25 @@ class Block(nn.Module):
     rope: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, pos=None):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + SelfAttention(
+        attn = SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
             window=self.window, kv_heads=self.kv_heads, rope=self.rope,
             mesh=self.mesh, dtype=self.dtype, name="attn",
-        )(y)
+        )(y, cache=cache, pos=pos)
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = attn
+        x = x + attn
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = nn.Dense(self.d_ff, dtype=self.dtype, param_dtype=jnp.float32,
                      name="mlp_in")(y.astype(self.dtype))
         y = nn.gelu(y)
         y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
                      name="mlp_out")(y)
-        return x + y
+        out = x + y
+        return out if new_cache is None else (out, new_cache)
 
 
 class LMHead(nn.Module):
