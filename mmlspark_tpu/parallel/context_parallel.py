@@ -59,14 +59,20 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool,
                 window: int | None, scale):
     """Per-shard ring attention body (runs under shard_map).
 
-    q, k, v: local sequence chunks (B, S/n, H, D). Chunk ownership after
-    ``step`` rotations: device i holds K/V chunk (i - step) mod n, which
-    gives the global kv offset for causal masking.
+    q, k, v: local sequence chunks (B, S/n, H, D); K/V may carry FEWER
+    heads (grouped-query attention) — the ring rotates the NARROW
+    (B, S/n, Hkv, D) chunks, so GQA's ICI-traffic saving (the reason
+    serving stacks pick it) survives sharding, and the repeat to query
+    heads happens per-step inside the local softmax update where XLA
+    fuses it into the score einsum. Chunk ownership after ``step``
+    rotations: device i holds K/V chunk (i - step) mod n, which gives
+    the global kv offset for causal masking.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hk = k.shape[1], k.shape[2]
+    rep = h // hk
     if scale is None:
         scale = d ** -0.5
 
@@ -83,7 +89,9 @@ def _ring_inner(q, k, v, *, axis_name: str, causal: bool,
             causal_block_mask(sq, sk, idx * sq, src * sk, window=window)
             if causal else None
         )
-        m, l, acc = softmax_block_update((m, l, acc), q, kc, vc, scale, mask)
+        kf = kc if rep == 1 else jnp.repeat(kc, rep, axis=2)
+        vf = vc if rep == 1 else jnp.repeat(vc, rep, axis=2)
+        m, l, acc = softmax_block_update((m, l, acc), q, kf, vf, scale, mask)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         return (m, l, acc, kc, vc), ()
@@ -156,6 +164,7 @@ def ring_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
             raise FriendlyError("window requires causal=True")
         if int(window) < 1:
             raise FriendlyError(f"window must be >= 1, got {window}")
+    _check_gqa(q, k, v, "ring")
     _check(mesh, axis, q.shape[1], "ring")
     inner = partial(_ring_inner, axis_name=axis, causal=causal,
                     window=window, scale=scale)
@@ -165,13 +174,17 @@ def ring_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
 def ulysses_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
                       causal: bool = False, window: int | None = None,
                       scale=None, batch_axis: str = DATA_AXIS):
-    """All-to-all sequence-parallel attention; heads must divide by the
-    axis size (each device attends H/n full-length heads)."""
+    """All-to-all sequence-parallel attention; q heads AND kv heads must
+    divide by the axis size (each device attends H/n full-length query
+    heads against Hkv/n key/value heads — the all-to-all re-shard
+    preserves the GQA group ratio, and the local flash/dense call does
+    the grouped expansion)."""
+    _check_gqa(q, k, v, "ulysses")
     n = _check(mesh, axis, q.shape[1], "ulysses")
-    if q.shape[2] % n:
+    if q.shape[2] % n or k.shape[2] % n:
         raise FriendlyError(
-            f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
-            f"'{axis}' ({n})"
+            f"ulysses needs q heads ({q.shape[2]}) and kv heads "
+            f"({k.shape[2]}) divisible by mesh axis '{axis}' ({n})"
         )
     if window is not None:
         if not causal:
@@ -181,6 +194,17 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = SEQUENCE_AXIS,
     inner = partial(_ulysses_inner, axis_name=axis, causal=causal,
                     window=window, scale=scale)
     return _sharded_call(inner, q, k, v, mesh, axis, batch_axis)
+
+
+def _check_gqa(q, k, v, what: str) -> None:
+    """Same grouped-query contract as dense/flash (ADVICE r4: direct
+    callers used to hit an opaque einsum shape error deep in the inner
+    body instead of this message)."""
+    if k.shape[2] != v.shape[2] or q.shape[2] % k.shape[2]:
+        raise FriendlyError(
+            f"{what} attention needs k/v heads equal and dividing q "
+            f"heads, got q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
+        )
 
 
 def _check(mesh, axis: str, seq_len: int, what: str) -> int:
