@@ -169,8 +169,19 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         if cached[0] is not src_key[0] or cached[1] != src_key[1]:
             host = self.weights
             if self.weight_quant == "int8":
+                from mmlspark_tpu.core.logging_utils import get_logger
                 from mmlspark_tpu.ops.quantize import quantize_weights
 
+                # measured honesty (docs/PERFORMANCE.md): at
+                # compute-bound batch sizes W8 REGRESSED on v5e (MFU
+                # 0.18 vs 0.39 bf16, r4 sweep); it is a bandwidth lever
+                # for weight-bound serving shapes only
+                get_logger(__name__).warning(
+                    "weight_quant='int8' is a weight-bandwidth lever: "
+                    "measured SLOWER than bf16 at compute-bound batch "
+                    "sizes on v5e (see docs/PERFORMANCE.md); use for "
+                    "latency-bound small-batch serving or HBM relief"
+                )
                 host = quantize_weights(host)
             self._dev_weights = jax.device_put(host)
             self._dev_weights_src = src_key
