@@ -71,7 +71,11 @@ class TrainConfig:
     # batch stays batch_size while activation memory drops to 1/K — the
     # complementary lever to remat when memory caps the batch. Exact for
     # mean losses over equal micro-batches (grads are averaged before
-    # the single optimizer update).
+    # the single optimizer update). NOT bit-equivalent for MoE models:
+    # sown auxiliary losses (load-balance) are computed per micro-batch
+    # and averaged, so expert routing balances within each micro-batch
+    # rather than across the full batch — a slightly different (still
+    # unbiased-in-spirit, standard-practice) estimator than accum=1.
     grad_accum: int = 1
     # weight on sown auxiliary losses (e.g. MoE load-balance, models/moe.py)
     moe_aux_weight: float = 1e-2
