@@ -14,8 +14,13 @@ Two decode strategies, both fixed-shape and single-jit:
   every shape is static). Per-token cost is one O(T) cache read +
   O(params) matmuls — independent of how many tokens have been
   generated, the property the recompute path lacked (VERDICT r4 weak #4).
-  Works unchanged with sliding window (masked against the same buffer),
-  GQA (narrow ``hk`` buffers), and RoPE (tables at offset positions).
+  Works unchanged with GQA (narrow ``hk`` buffers) and RoPE (tables at
+  offset positions). **Sliding-window models roll the cache**: after
+  prefill the per-block buffers shrink to ``(B, window, hk, d)``
+  circular buffers (slot = pos % W; every written slot is inside the
+  query's window by construction — ``ops.attention.
+  rolled_window_attention``), so steady-state decode memory is
+  O(window) no matter how long the generation runs.
 
 - **full recompute** (``kv_cache=False``): each step re-runs the whole
   (B, P+N) buffer through the model with future positions causally
@@ -51,17 +56,18 @@ def init_cache(graph, variables, batch: int, total: int) -> dict:
     return cache
 
 
-def _cached_apply(graph, variables, ids, cache, pos):
+def _cached_apply(graph, variables, ids, cache, pos, rolled=False):
     """One forward over ``ids`` (B, T) starting at absolute position
     ``pos`` (traced ok), reading/writing the K/V cache. Returns
-    (logits (B, T, V), new cache)."""
+    (logits (B, T, V), new cache). ``rolled`` switches the blocks to
+    the O(window) circular-buffer decode."""
     x = ids
     new_cache = dict(cache)
     for name, mod in graph.blocks:
         v = variables[name]
         if name in cache:
             x, new_cache[name] = mod.apply(
-                v, x, cache=cache[name], pos=pos
+                v, x, cache=cache[name], pos=pos, rolled=rolled
             )
         elif _accepts_kwarg(mod, "pos"):
             x = mod.apply(v, x, pos=pos)
@@ -70,16 +76,44 @@ def _cached_apply(graph, variables, ids, cache, pos):
     return x, new_cache
 
 
+def _roll_prefill_cache(cache, p: int, window: int) -> dict:
+    """Fold a linear prefill cache (buffers of length ``p``) into
+    circular window buffers of length ``window``: the last
+    min(p, window) K/V land at their ``pos % window`` slots (static
+    scatter — all indices are Python ints at trace time); older
+    positions are outside every future query's window and are dropped,
+    which is the whole point."""
+    import numpy as np
+
+    wm = min(p, window)
+    slots = np.arange(p - wm, p) % window
+    out = {}
+    for name, (ck, cv) in cache.items():
+        b, _, hk, d = ck.shape
+        rk = jnp.zeros((b, window, hk, d), ck.dtype)
+        rv = jnp.zeros((b, window, hk, d), cv.dtype)
+        out[name] = (
+            rk.at[:, slots].set(ck[:, p - wm:]),
+            rv.at[:, slots].set(cv[:, p - wm:]),
+        )
+    return out
+
+
 def generate(graph, variables, prompt, max_new_tokens: int, *,
-             temperature: float = 0.0, rng=None, pad_id: int = 0,
+             temperature: float = 0.0, top_k: int | None = None,
+             top_p: float | None = None, rng=None, pad_id: int = 0,
              kv_cache: bool = True):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     ``graph`` must be a causal LM whose ``apply`` returns per-position
     logits (the ``transformer_lm`` family); ``prompt`` is (B, P) int32.
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at
-    the given temperature using ``rng`` (required then). Returns the
-    (B, P + max_new_tokens) int32 buffer including the prompt.
+    the given temperature using ``rng`` (required then), optionally
+    truncated to the ``top_k`` highest-probability tokens and/or the
+    nucleus holding ``top_p`` cumulative mass (both filters are static-
+    shape: a lax.top_k threshold and a sorted-cumsum threshold, applied
+    inside the jitted step). Returns the (B, P + max_new_tokens) int32
+    buffer including the prompt.
 
     ``kv_cache=True`` (default) decodes with the preallocated K/V cache
     (per-token cost independent of generated length); ``False`` uses the
@@ -110,6 +144,20 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
         )
     if temperature > 0.0 and rng is None:
         raise FriendlyError("sampling (temperature > 0) needs rng")
+    if (top_k is not None or top_p is not None) and temperature <= 0.0:
+        raise FriendlyError(
+            "top_k/top_p shape the SAMPLING distribution; they need "
+            "temperature > 0 (greedy decode ignores them by definition)"
+        )
+    vocab = graph.extra.get("vocab_size")
+    if top_k is not None and (
+        top_k < 1 or (vocab and top_k > vocab)
+    ):
+        raise FriendlyError(
+            f"top_k must be in [1, vocab_size={vocab}], got {top_k}"
+        )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise FriendlyError(f"top_p must be in (0, 1], got {top_p}")
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
     total = p + max_new_tokens
@@ -131,25 +179,51 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
 
     def pick(cur, rng):
         # cur: (B, V) f32 logits for the next token
-        if temperature > 0.0:
-            rng, sub = jax.random.split(rng)
-            return jax.random.categorical(
-                sub, cur / temperature, axis=-1
-            ).astype(jnp.int32), rng
-        return jnp.argmax(cur, axis=-1).astype(jnp.int32), rng
+        if temperature <= 0.0:
+            return jnp.argmax(cur, axis=-1).astype(jnp.int32), rng
+        logits = cur / temperature
+        if top_k is not None:
+            # kth-highest logit per row is the keep threshold
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            # nucleus: keep the shortest prefix of the sorted
+            # distribution whose mass reaches top_p (the top token is
+            # always kept: its preceding mass is 0 < top_p)
+            sorted_desc = -jnp.sort(-logits, axis=-1)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            mass_before = jnp.cumsum(probs, axis=-1) - probs
+            kept = mass_before < top_p
+            thresh = jnp.min(
+                jnp.where(kept, sorted_desc, jnp.inf),
+                axis=-1, keepdims=True,
+            )
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
+        rng, sub = jax.random.split(rng)
+        return jax.random.categorical(
+            sub, logits, axis=-1
+        ).astype(jnp.int32), rng
 
     if kv_cache:
-        cache = init_cache(graph, variables, b, total)
+        # sliding-window models roll the cache: steady-state memory is
+        # O(window) instead of O(P+N) — the long-generation regime the
+        # window exists for. The linear cache only needs to cover the
+        # prefill then.
+        window = graph.extra.get("window")
+        rolled = bool(window) and window < total
+        cache = init_cache(graph, variables, b, p if rolled else total)
         # prefill: one call over the whole prompt at pos 0
         logits, cache = _cached_apply(graph, variables, prompt, cache, 0)
         first, rng = pick(logits[:, -1].astype(jnp.float32), rng)
         if max_new_tokens == 1:
             return jnp.concatenate([prompt, first[:, None]], axis=1)
+        if rolled:
+            cache = _roll_prefill_cache(cache, p, window)
 
         def step(carry, _):
             tok, cache, pos, rng = carry
             logits, cache = _cached_apply(
-                graph, variables, tok[:, None], cache, pos
+                graph, variables, tok[:, None], cache, pos, rolled=rolled
             )
             nxt, rng = pick(logits[:, 0].astype(jnp.float32), rng)
             return (nxt, cache, pos + 1, rng), nxt

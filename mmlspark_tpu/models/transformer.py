@@ -88,7 +88,7 @@ class SelfAttention(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, cache=None, pos=None):
+    def __call__(self, x, cache=None, pos=None, rolled=False):
         b, t, _ = x.shape
         h, d = self.heads, self.head_dim
         hk = self.kv_heads or h
@@ -126,15 +126,39 @@ class SelfAttention(nn.Module):
             if not self.causal:
                 raise ParamError("cache decode requires causal=True")
             ck, cv = cache
-            ck = jax.lax.dynamic_update_slice(
-                ck, k.astype(ck.dtype), (0, pos, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v.astype(cv.dtype), (0, pos, 0, 0)
-            )
-            new_cache = (ck, cv)
-            o = dense_attention(q, ck, cv, causal=True,
-                                window=self.window, q_offset=pos)
+            if rolled:
+                # O(window) circular cache (sliding-window models on
+                # long generations): this step's K/V land at slot
+                # pos % W; every written slot is inside the window by
+                # construction (ops/attention.py rolled_window_attention)
+                if t != 1:
+                    raise ParamError(
+                        "rolled cache decode is single-token (t=1); "
+                        "prefill uses the linear cache path"
+                    )
+                from mmlspark_tpu.ops.attention import (
+                    rolled_window_attention,
+                )
+
+                slot = pos % ck.shape[1]
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, slot, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, slot, 0, 0)
+                )
+                new_cache = (ck, cv)
+                o = rolled_window_attention(q, ck, cv, pos)
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, pos, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, pos, 0, 0)
+                )
+                new_cache = (ck, cv)
+                o = dense_attention(q, ck, cv, causal=True,
+                                    window=self.window, q_offset=pos)
         elif impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 
@@ -178,13 +202,13 @@ class Block(nn.Module):
     rope: bool = False
 
     @nn.compact
-    def __call__(self, x, cache=None, pos=None):
+    def __call__(self, x, cache=None, pos=None, rolled=False):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         attn = SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
             window=self.window, kv_heads=self.kv_heads, rope=self.rope,
             mesh=self.mesh, dtype=self.dtype, name="attn",
-        )(y, cache=cache, pos=pos)
+        )(y, cache=cache, pos=pos, rolled=rolled)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn

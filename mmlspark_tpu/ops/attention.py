@@ -74,6 +74,57 @@ def finalize_softmax(l, acc, dtype):
     return (acc / denom).astype(dtype)
 
 
+def _validate_and_expand_gqa(q, k, v):
+    """Shared grouped-query contract: k/v heads equal and dividing q
+    heads, expanded to q heads by repeat (query head i reads kv head
+    i // group). ONE definition so the dense reference and the rolled
+    decode path can never drift apart."""
+    if k.shape[2] != v.shape[2] or q.shape[2] % k.shape[2]:
+        raise ValueError(
+            "k/v heads must be equal and divide q heads, got "
+            f"q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
+        )
+    rep = q.shape[2] // k.shape[2]
+    if rep != 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def rolled_window_attention(q, k, v, pos, *, scale=None):
+    """One decode step against a ROLLED sliding-window cache.
+
+    ``k``/``v`` are (B, W, Hkv, D) circular buffers where slot ``j``
+    holds the key/value at the latest absolute position congruent to
+    ``j`` mod W that is <= ``pos`` — by construction every written slot
+    is inside the causal window of the query at ``pos``, so no window
+    mask is needed; the only masking is validity for slots not yet
+    written while ``pos < W``. ``q`` is (B, 1, H, D) (single decode
+    step); ``pos`` may be traced. GQA follows the dense convention
+    (fewer K/V heads, repeated to query heads).
+
+    This is what keeps long generations O(window) in memory: the
+    framework's sliding-window models never need a (B, P+N, ...) cache
+    (models/generate.py picks this path automatically).
+    """
+    k, v = _validate_and_expand_gqa(q, k, v)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    w = k.shape[1]
+    valid = jnp.arange(w)[None, None, None, :] <= pos  # pos >= W: all on
+    s = jnp.where(valid, s, NEG_INF)
+    # the slot at pos % W is always valid, so no fully-masked rows exist
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return (out / jnp.moveaxis(p.sum(axis=-1), 1, 2)[..., None]).astype(
+        q.dtype
+    )
+
+
 def dense_attention(q, k, v, *, causal: bool = False,
                     window: int | None = None, scale=None,
                     q_offset: int = 0, kv_offset: int = 0):
@@ -90,18 +141,10 @@ def dense_attention(q, k, v, *, causal: bool = False,
             raise ValueError("window requires causal=True")
         if int(window) < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-    if k.shape[2] != v.shape[2] or q.shape[2] % k.shape[2]:
-        raise ValueError(
-            "k/v heads must be equal and divide q heads, got "
-            f"q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
-        )
-    if k.shape[2] != q.shape[2]:
-        # grouped-query attention, same convention as the flash kernel
-        # (query head i -> kv head i // group); the dense REFERENCE just
-        # repeats — the kernel is where the no-copy expansion lives
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # grouped-query attention, same convention as the flash kernel
+    # (query head i -> kv head i // group); the dense REFERENCE just
+    # repeats — the kernel is where the no-copy expansion lives
+    k, v = _validate_and_expand_gqa(q, k, v)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     s = jnp.einsum(

@@ -62,8 +62,9 @@ def test_overfit_lm_continues_the_period(config):
 
 @pytest.mark.parametrize("config", [
     {},                                            # plain learned-pos
-    {"window": 6},                                 # sliding window
+    {"window": 6},                                 # rolled window cache
     {"pos_embedding": "rope", "kv_heads": 1},      # RoPE + MQA
+    {"window": 6, "kv_heads": 1},                  # rolled cache + GQA
 ])
 def test_kv_cache_matches_recompute_oracle(config):
     """The cached decode (one-token steps against preallocated K/V
@@ -129,6 +130,56 @@ def test_rope_generates_past_trained_max_len():
     out = np.asarray(generate(m, v, ids, max_new_tokens=8))  # 24 > 16
     want = (np.arange(24) % PERIOD) + 1
     np.testing.assert_array_equal(out[0], want)
+
+
+def test_rolled_window_cache_long_generation():
+    """A sliding-window model generating far past both its window and
+    its trained max_len: the decode carry holds O(window) K/V (the
+    rolled circular buffers), RoPE extrapolates structurally, and the
+    learned period must continue across many buffer wrap-arounds."""
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=16, window=8, pos_embedding="rope")
+    v, ids = _train_lm(m, seq=16)
+    out = np.asarray(generate(m, v, ids, max_new_tokens=32))  # 48 >> W=8
+    want = (np.arange(48) % PERIOD) + 1
+    np.testing.assert_array_equal(out[0], want)
+
+
+def test_top_k_and_top_p_sampling():
+    """top_k=1 collapses sampling to greedy; a tight nucleus on a
+    peaked (trained) model does too; loose filters reproduce the
+    unfiltered stream rng-for-rng; guards reject meaningless configs."""
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=32)
+    v, ids = _train_lm(m)
+    prompt = ids[:, :8]
+    greedy = np.asarray(generate(m, v, prompt, max_new_tokens=8))
+    k1 = np.asarray(generate(m, v, prompt, max_new_tokens=8,
+                             temperature=1.0, top_k=1,
+                             rng=jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(k1, greedy)
+    # the overfit model is sharply peaked: a 0.5 nucleus holds only the
+    # top token, so nucleus sampling = greedy here
+    p_small = np.asarray(generate(m, v, prompt, max_new_tokens=8,
+                                  temperature=1.0, top_p=0.5,
+                                  rng=jax.random.PRNGKey(1)))
+    np.testing.assert_array_equal(p_small, greedy)
+    # loose filters change nothing about the sampled stream
+    base = np.asarray(generate(m, v, prompt, max_new_tokens=8,
+                               temperature=1.3,
+                               rng=jax.random.PRNGKey(2)))
+    loose = np.asarray(generate(m, v, prompt, max_new_tokens=8,
+                                temperature=1.3, top_k=8, top_p=1.0,
+                                rng=jax.random.PRNGKey(2)))
+    np.testing.assert_array_equal(base, loose)
+    with pytest.raises(FriendlyError, match="temperature"):
+        generate(m, v, prompt, max_new_tokens=2, top_k=2)
+    with pytest.raises(FriendlyError, match="top_k"):
+        generate(m, v, prompt, max_new_tokens=2, temperature=1.0,
+                 top_k=9, rng=jax.random.PRNGKey(0))
+    with pytest.raises(FriendlyError, match="top_p"):
+        generate(m, v, prompt, max_new_tokens=2, temperature=1.0,
+                 top_p=1.5, rng=jax.random.PRNGKey(0))
 
 
 def test_generate_rejects_moe_and_negative_temperature():
