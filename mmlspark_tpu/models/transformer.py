@@ -125,38 +125,32 @@ class SelfAttention(nn.Module):
             # gains nothing from the flash/ring decompositions.
             if not self.causal:
                 raise ParamError("cache decode requires causal=True")
+            if rolled and t != 1:
+                raise ParamError(
+                    "rolled cache decode is single-token (t=1); "
+                    "prefill uses the linear cache path"
+                )
             ck, cv = cache
+            # rolled (O(window) circular, sliding-window models on long
+            # generations): this step's K/V land at slot pos % W —
+            # every written slot is inside the window by construction
+            # (ops/attention.py rolled_window_attention). Linear: the
+            # write index IS the absolute position.
+            idx = pos % ck.shape[1] if rolled else pos
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, idx, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, idx, 0, 0)
+            )
+            new_cache = (ck, cv)
             if rolled:
-                # O(window) circular cache (sliding-window models on
-                # long generations): this step's K/V land at slot
-                # pos % W; every written slot is inside the window by
-                # construction (ops/attention.py rolled_window_attention)
-                if t != 1:
-                    raise ParamError(
-                        "rolled cache decode is single-token (t=1); "
-                        "prefill uses the linear cache path"
-                    )
                 from mmlspark_tpu.ops.attention import (
                     rolled_window_attention,
                 )
 
-                slot = pos % ck.shape[1]
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (0, slot, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (0, slot, 0, 0)
-                )
-                new_cache = (ck, cv)
                 o = rolled_window_attention(q, ck, cv, pos)
             else:
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (0, pos, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (0, pos, 0, 0)
-                )
-                new_cache = (ck, cv)
                 o = dense_attention(q, ck, cv, causal=True,
                                     window=self.window, q_offset=pos)
         elif impl == FLASH:

@@ -40,6 +40,8 @@ TITLES = {
     "e305": "305 - ImageFeaturizer: basic vs DNN featurization",
     # beyond the reference's ten: TPU-native long-context story
     "e306": "306 - Long-Context Ring Attention (sequence parallelism)",
+    "e307": "307 - Generation with KV-Cache Decode (rolled window, "
+            "nucleus sampling)",
 }
 
 
