@@ -56,19 +56,25 @@ def init_cache(graph, variables, batch: int, total: int) -> dict:
     return cache
 
 
-def _cached_apply(graph, variables, ids, cache, pos, rolled=False):
+def _cached_apply(graph, variables, ids, cache, pos, rolled=False,
+                  step=False):
     """One forward over ``ids`` (B, T) starting at absolute position
     ``pos`` (traced ok), reading/writing the K/V cache. Returns
     (logits (B, T, V), new cache). ``rolled`` switches the blocks to
-    the O(window) circular-buffer decode."""
+    the O(window) circular-buffer decode; ``step`` marks a DECODE step
+    (vs the prefill call) for blocks that route differently there —
+    MoE's dropless decode routing. Explicit, not inferred from T: a
+    one-token PROMPT is still a prefill and must route with scoring
+    semantics."""
     x = ids
     new_cache = dict(cache)
     for name, mod in graph.blocks:
         v = variables[name]
         if name in cache:
-            x, new_cache[name] = mod.apply(
-                v, x, cache=cache[name], pos=pos, rolled=rolled
-            )
+            kwargs = {"cache": cache[name], "pos": pos, "rolled": rolled}
+            if _accepts_kwarg(mod, "decode"):
+                kwargs["decode"] = step
+            x, new_cache[name] = mod.apply(v, x, **kwargs)
         elif _accepts_kwarg(mod, "pos"):
             x = mod.apply(v, x, pos=pos)
         else:
@@ -124,15 +130,19 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
             f"generate() needs a causal LM; '{graph.name}' has "
             "causal=False (bidirectional logits leak future positions)"
         )
-    if graph.extra.get("n_experts"):
-        # expert-capacity routing is NOT causal: the buffer's pad-filled
-        # future positions would be routed too, consuming capacity slots
-        # ahead of later batch rows' real tokens and silently changing
-        # their logits vs a prompt-length forward
+    if graph.extra.get("n_experts") and not kv_cache:
+        # expert-capacity routing is NOT causal over the recompute
+        # path's PAD-FILLED buffer: future pad positions would be routed
+        # too, consuming capacity slots ahead of later batch rows' real
+        # tokens and silently changing their logits. The kv_cache path
+        # has no pads anywhere — prefill routes exactly the prompt
+        # (scoring semantics) and decode steps route droplessly — so MoE
+        # generation is supported THERE (round 5).
         raise FriendlyError(
-            f"generate() does not support MoE routing ('{graph.name}'): "
-            "capacity-based dispatch over the fixed decode buffer is not "
-            "causal; use a dense-FFN transformer_lm"
+            f"generate(kv_cache=False) does not support MoE routing "
+            f"('{graph.name}'): capacity dispatch over the pad-filled "
+            "recompute buffer is not causal; use the default kv_cache "
+            "decode"
         )
     if max_new_tokens < 1:
         raise FriendlyError(
@@ -223,7 +233,8 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
         def step(carry, _):
             tok, cache, pos, rng = carry
             logits, cache = _cached_apply(
-                graph, variables, tok[:, None], cache, pos, rolled=rolled
+                graph, variables, tok[:, None], cache, pos,
+                rolled=rolled, step=True,
             )
             nxt, rng = pick(logits[:, 0].astype(jnp.float32), rng)
             return (nxt, cache, pos + 1, rng), nxt

@@ -29,7 +29,11 @@ from mmlspark_tpu.models.transformer import (
     TokenPosEmbed,
     resolve_attn_impl,
 )
-from mmlspark_tpu.parallel.expert import moe_ffn, validate_experts
+from mmlspark_tpu.parallel.expert import (
+    moe_ffn,
+    moe_ffn_dropless,
+    validate_experts,
+)
 
 
 class _ExpertParams(nn.Module):
@@ -63,13 +67,21 @@ class MoEFFN(nn.Module):
     group_size: int = 1024
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, decode=False):
         d = x.shape[-1]
         gate = self.param("gate", nn.initializers.lecun_normal(),
                           (d, self.n_experts), jnp.float32)
         w_in, b_in, w_out, b_out = _ExpertParams(
             self.n_experts, d, self.d_ff, name="experts"
         )()
+        if decode:
+            # one-token decode steps: dropless per-token expert gather —
+            # capacity dispatch at B tokens would drop streams whenever
+            # routing concentrates (parallel/expert.py moe_ffn_dropless)
+            out = moe_ffn_dropless(
+                x.astype(self.dtype), gate, w_in, b_in, w_out, b_out
+            )
+            return out.astype(x.dtype)
         out, aux = moe_ffn(
             x.astype(self.dtype), gate, w_in, b_in, w_out, b_out,
             capacity_factor=self.capacity_factor, mask=mask,
@@ -93,17 +105,29 @@ class MoEBlock(nn.Module):
     rope: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, cache=None, pos=None, rolled=False,
+                 decode=False):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + SelfAttention(self.heads, self.head_dim, self.causal,
-                              resolve_attn_impl(self.attn_impl),
-                              window=self.window, kv_heads=self.kv_heads,
-                              rope=self.rope, mesh=None, dtype=self.dtype,
-                              name="attn")(y)
+        attn = SelfAttention(self.heads, self.head_dim, self.causal,
+                             resolve_attn_impl(self.attn_impl),
+                             window=self.window, kv_heads=self.kv_heads,
+                             rope=self.rope, mesh=None, dtype=self.dtype,
+                             name="attn")(y, cache=cache, pos=pos,
+                                          rolled=rolled)
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = attn
+        x = x + attn
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        # ``decode`` is the EXPLICIT decode-step marker from
+        # models/generate.py: decode steps route droplessly, while the
+        # prefill call — even a one-token prompt — keeps the capacity
+        # path, which over the unpadded prompt is exactly the scoring
+        # forward
         y = MoEFFN(self.n_experts, self.d_ff, self.capacity_factor,
-                   self.dtype, name="moe")(y, mask)
-        return x + y
+                   self.dtype, name="moe")(y, mask, decode=decode)
+        out = x + y
+        return out if new_cache is None else (out, new_cache)
 
 
 @register_model("transformer_lm_moe")
@@ -164,6 +188,7 @@ def transformer_lm_moe(
             "vocab_size": vocab_size,
             "n_experts": n_experts,
             "causal": causal,
+            "heads": heads,
             "window": window,
             "kv_heads": kv_heads,
             "pos_embedding": pos_embedding,

@@ -143,6 +143,33 @@ def moe_ffn(x, gate_w, w_in, b_in, w_out, b_out, *,
     return out.reshape(b, t, d).astype(x.dtype), aux
 
 
+def moe_ffn_dropless(x, gate_w, w_in, b_in, w_out, b_out):
+    """Dropless top-1 routing for DECODE steps (models/generate.py).
+
+    Capacity-bounded dispatch exists to keep training-scale token counts
+    fixed-shape and balanced; at decode there are only B tokens (one per
+    sequence) and dropping any of them would corrupt the stream outright.
+    Each token instead gathers its argmax expert's weights directly —
+    (B, D, F) per-token weight reads, trivially affordable at decode
+    batch sizes — and the output is gate-prob scaled exactly like the
+    capacity path scales kept tokens, so wherever the capacity path
+    drops nothing the two are numerically equivalent (tested in
+    tests/test_moe.py). No aux loss: routing balance is a training
+    concern."""
+    b, t, d = x.shape
+    flat = x.reshape(b * t, d)
+    probs = router_probs(flat, gate_w)  # (N, E) float32
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    h = jnp.einsum("nd,ndf->nf", flat.astype(x.dtype),
+                   w_in[expert].astype(x.dtype))
+    h = jax.nn.gelu(h + b_in[expert].astype(x.dtype))
+    y = jnp.einsum("nf,nfd->nd", h, w_out[expert].astype(x.dtype))
+    y = y + b_out[expert].astype(x.dtype)
+    out = y.astype(jnp.float32) * gate[:, None]
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
 def validate_experts(n_experts: int, mesh=None) -> None:
     if n_experts < 2:
         raise ParamError(f"need >= 2 experts, got {n_experts}")

@@ -185,3 +185,36 @@ def make_flights(n: int = 800, seed: int = 3) -> Dataset:
         "carrier": list(carrier),
         "arr_delay": delay,
     })
+
+
+def overfit_periodic_lm(graph, *, steps: int = 60, seq: int = 16,
+                        period: int = 4, lr: float = 5e-2):
+    """Overfit a causal LM on a periodic token stream (1..period
+    cycling) and return ``(variables, ids)`` — the shared recipe behind
+    the generation behavioral tests (tests/test_generate.py,
+    tests/test_moe.py): a model that has memorized the period makes
+    greedy continuation exactly predictable."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    ids = jnp.asarray((np.arange(seq)[None] % period) + 1, jnp.int32)
+    variables = graph.init(jax.random.PRNGKey(0), ids)
+    opt = optax.adam(lr)
+    state = opt.init(variables)
+
+    def loss(p):
+        lg = graph.apply(p, ids).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1], ids[:, 1:]
+        ).mean()
+
+    @jax.jit
+    def step(p, st):
+        g = jax.grad(loss)(p)
+        up, st = opt.update(g, st, p)
+        return optax.apply_updates(p, up), st
+
+    for _ in range(steps):
+        variables, state = step(variables, state)
+    return variables, ids

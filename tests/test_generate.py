@@ -12,7 +12,6 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.models import build_model, generate
@@ -21,28 +20,9 @@ PERIOD = 4  # token stream cycles 1,2,3,4,1,2,...
 
 
 def _train_lm(m, steps=60, seq=16):
-    ids = jnp.asarray(
-        (np.arange(seq)[None] % PERIOD) + 1, jnp.int32
-    )  # (1, seq)
-    v = m.init(jax.random.PRNGKey(0), ids)
-    opt = optax.adam(5e-2)
-    st = opt.init(v)
+    from mmlspark_tpu.testing.datagen import overfit_periodic_lm
 
-    def loss(p):
-        lg = m.apply(p, ids).astype(jnp.float32)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            lg[:, :-1], ids[:, 1:]
-        ).mean()
-
-    @jax.jit
-    def step(p, st):
-        g = jax.grad(loss)(p)
-        up, st = opt.update(g, st, p)
-        return optax.apply_updates(p, up), st
-
-    for _ in range(steps):
-        v, st = step(v, st)
-    return v, ids
+    return overfit_periodic_lm(m, steps=steps, seq=seq, period=PERIOD)
 
 
 @pytest.mark.parametrize("config", [
@@ -182,15 +162,21 @@ def test_top_k_and_top_p_sampling():
                  top_p=1.5, rng=jax.random.PRNGKey(0))
 
 
-def test_generate_rejects_moe_and_negative_temperature():
+def test_generate_rejects_moe_recompute_and_negative_temperature():
     m = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
                     depth=1, max_len=16)
     v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     with pytest.raises(FriendlyError, match="temperature"):
         generate(m, v, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2,
                  temperature=-0.5, rng=jax.random.PRNGKey(0))
+    # MoE decodes on the kv-cache path (r5); only the pad-filled
+    # recompute buffer stays rejected (capacity routing over pads is
+    # not causal). Full MoE generation semantics: tests/test_moe.py.
     moe = build_model("transformer_lm_moe", vocab_size=8, d_model=16,
                       heads=2, depth=1, max_len=16, n_experts=2)
     mv = moe.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
-    with pytest.raises(FriendlyError, match="MoE"):
-        generate(moe, mv, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2)
+    out = generate(moe, mv, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2)
+    assert out.shape == (1, 6)
+    with pytest.raises(FriendlyError, match="kv_cache"):
+        generate(moe, mv, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2,
+                 kv_cache=False)

@@ -247,3 +247,70 @@ def test_moe_attention_features_uniform_with_dense_lm():
     with pytest.raises(ParamError, match="kv_heads"):
         build_model("transformer_lm_moe", vocab_size=32, d_model=16,
                     heads=4, depth=1, n_experts=2, max_len=16, kv_heads=3)
+
+
+def test_moe_ffn_dropless_matches_capacity_path():
+    """The decode-step dropless router must equal the capacity path
+    wherever the latter drops nothing (ample capacity) — the numerical
+    contract that makes kv-cache MoE generation exact."""
+    from mmlspark_tpu.parallel.expert import moe_ffn_dropless
+
+    rng = np.random.default_rng(1)
+    b, t, d, f, e = 2, 4, 8, 16, 3
+    x = jnp.asarray(rng.normal(size=(b, t, d)), jnp.float32)
+    gate = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+    w_in = jnp.asarray(rng.normal(size=(e, d, f)) * 0.1, jnp.float32)
+    b_in = jnp.asarray(rng.normal(size=(e, f)) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.normal(size=(e, f, d)) * 0.1, jnp.float32)
+    b_out = jnp.asarray(rng.normal(size=(e, d)) * 0.1, jnp.float32)
+    cap_out, _ = moe_ffn(x, gate, w_in, b_in, w_out, b_out,
+                         capacity_factor=float(e))
+    drop_out = moe_ffn_dropless(x, gate, w_in, b_in, w_out, b_out)
+    np.testing.assert_allclose(np.asarray(drop_out), np.asarray(cap_out),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_generate_kv_cache_matches_unpadded_oracle():
+    """MoE generation (round 5): the kv-cache path routes the prefill
+    through the capacity path over the UNPADDED prompt and decode steps
+    droplessly. With capacity >= tokens (nothing ever dropped), greedy
+    tokens must equal the growing-unpadded-buffer oracle — a plain
+    scoring forward per step, the semantics a user scores with."""
+    from mmlspark_tpu.core.exceptions import FriendlyError
+    from mmlspark_tpu.models import build_model, generate
+    from mmlspark_tpu.testing.datagen import overfit_periodic_lm
+
+    m = build_model(
+        "transformer_lm_moe", vocab_size=8, d_model=32, heads=2, depth=2,
+        max_len=32, n_experts=2, capacity_factor=2.0,  # capacity = tokens
+    )
+    v, ids = overfit_periodic_lm(m, steps=40)
+    prompt = ids[:, :6]
+    out = np.asarray(generate(m, v, prompt, max_new_tokens=8))
+    buf = np.asarray(prompt)
+    for _ in range(8):
+        lg = np.asarray(m.apply(v, jnp.asarray(buf)))
+        nxt = lg[:, -1].argmax(-1).astype(np.int32)
+        buf = np.concatenate([buf, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, buf)
+    # the pad-filled recompute path stays rejected
+    with pytest.raises(FriendlyError, match="kv_cache"):
+        generate(m, v, prompt, max_new_tokens=2, kv_cache=False)
+
+
+def test_moe_one_token_prompt_prefill_uses_capacity_routing():
+    """Regression (r5 review): a (B, 1) PROMPT is a prefill, not a
+    decode step — its logits must equal the plain scoring forward even
+    under a capacity so tight that the dropless decode router would
+    disagree (all rows route to one expert; capacity keeps only one)."""
+    from mmlspark_tpu.models import build_model, generate
+
+    m = build_model(
+        "transformer_lm_moe", vocab_size=8, d_model=16, heads=2, depth=1,
+        max_len=8, n_experts=2, capacity_factor=0.5,
+    )
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    prompt = jnp.full((4, 1), 3, jnp.int32)  # identical rows: one expert
+    out = np.asarray(generate(m, v, prompt, max_new_tokens=1))
+    want = np.asarray(m.apply(v, prompt))[:, -1].argmax(-1)
+    np.testing.assert_array_equal(out[:, 1], want)
