@@ -108,7 +108,7 @@ def _roll_prefill_cache(cache, p: int, window: int) -> dict:
 def generate(graph, variables, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int | None = None,
              top_p: float | None = None, rng=None, pad_id: int = 0,
-             kv_cache: bool = True):
+             eos_id: int | None = None, kv_cache: bool = True):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     ``graph`` must be a causal LM whose ``apply`` returns per-position
@@ -120,6 +120,11 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
     shape: a lax.top_k threshold and a sorted-cumsum threshold, applied
     inside the jitted step). Returns the (B, P + max_new_tokens) int32
     buffer including the prompt.
+
+    ``eos_id`` stops a sequence once it emits that token: its remaining
+    positions fill with ``pad_id``. Shapes stay static (the scan always
+    runs ``max_new_tokens`` steps — finished rows just write pads), so
+    one compiled program serves every stopping pattern.
 
     ``kv_cache=True`` (default) decodes with the preallocated K/V cache
     (per-token cost independent of generated length); ``False`` uses the
@@ -214,6 +219,14 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
             sub, logits, axis=-1
         ).astype(jnp.int32), rng
 
+    def advance(nxt, done):
+        # eos handling: a finished row emits pads from then on; shapes
+        # stay static, only the written value changes
+        if eos_id is None:
+            return nxt, done
+        emit = jnp.where(done, jnp.asarray(pad_id, jnp.int32), nxt)
+        return emit, done | (emit == eos_id)
+
     if kv_cache:
         # sliding-window models roll the cache: steady-state memory is
         # O(window) instead of O(P+N) — the long-generation regime the
@@ -225,22 +238,26 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
         # prefill: one call over the whole prompt at pos 0
         logits, cache = _cached_apply(graph, variables, prompt, cache, 0)
         first, rng = pick(logits[:, -1].astype(jnp.float32), rng)
+        first, done = advance(first, jnp.zeros((b,), bool))
         if max_new_tokens == 1:
             return jnp.concatenate([prompt, first[:, None]], axis=1)
         if rolled:
             cache = _roll_prefill_cache(cache, p, window)
 
         def step(carry, _):
-            tok, cache, pos, rng = carry
+            tok, cache, pos, rng, done = carry
             logits, cache = _cached_apply(
                 graph, variables, tok[:, None], cache, pos,
                 rolled=rolled, step=True,
             )
             nxt, rng = pick(logits[:, 0].astype(jnp.float32), rng)
-            return (nxt, cache, pos + 1, rng), nxt
+            nxt, done = advance(nxt, done)
+            return (nxt, cache, pos + 1, rng, done), nxt
 
-        (_, _, _, _), toks = jax.lax.scan(
-            step, (first, cache, jnp.asarray(p, jnp.int32), rng), None,
+        (_, _, _, _, _), toks = jax.lax.scan(
+            step,
+            (first, cache, jnp.asarray(p, jnp.int32), rng, done),
+            None,
             length=max_new_tokens - 1,
         )
         return jnp.concatenate(
@@ -251,20 +268,23 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
 
     def step(carry, _):
-        buf, pos, rng = carry
+        buf, pos, rng, done = carry
         logits = graph.apply(variables, buf).astype(jnp.float32)
         # logits for the token AT pos come from position pos-1
         cur = jax.lax.dynamic_slice_in_dim(
             logits, pos - 1, 1, axis=1
         )[:, 0]  # (B, V) via dynamic index; pos is traced
         nxt, rng = pick(cur, rng)
+        nxt, done = advance(nxt, done)
         buf = jax.lax.dynamic_update_slice(
             buf, nxt[:, None], (0, pos)
         )
-        return (buf, pos + 1, rng), None
+        return (buf, pos + 1, rng, done), None
 
-    (buf, _, _), _ = jax.lax.scan(
-        step, (buf, jnp.asarray(p, jnp.int32), rng), None,
+    (buf, _, _, _), _ = jax.lax.scan(
+        step,
+        (buf, jnp.asarray(p, jnp.int32), rng, jnp.zeros((b,), bool)),
+        None,
         length=max_new_tokens,
     )
     return buf

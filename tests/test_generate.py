@@ -112,6 +112,31 @@ def test_rope_generates_past_trained_max_len():
     np.testing.assert_array_equal(out[0], want)
 
 
+def test_eos_stops_rows_and_pads_the_tail():
+    """eos_id: the trained model walks the period 1,2,3,4,...; stopping
+    at eos_id=3 must keep tokens up to AND including the first 3, then
+    pad — identically on the cache path and the recompute oracle."""
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=32)
+    v, ids = _train_lm(m)
+    prompt = ids[:, :8]  # ends ...3,4 → continuation 1,2,3,4,...
+    kv = np.asarray(generate(m, v, prompt, max_new_tokens=8, eos_id=3))
+    want = np.concatenate([
+        np.asarray(prompt)[0], [1, 2, 3, 0, 0, 0, 0, 0],
+    ])
+    np.testing.assert_array_equal(kv[0], want)
+    rc = np.asarray(generate(m, v, prompt, max_new_tokens=8, eos_id=3,
+                             kv_cache=False))
+    np.testing.assert_array_equal(kv, rc)
+    # pad_id is honored for the tail fill
+    pk = np.asarray(generate(m, v, prompt, max_new_tokens=8, eos_id=3,
+                             pad_id=7))
+    np.testing.assert_array_equal(
+        pk[0], np.concatenate([np.asarray(prompt)[0],
+                               [1, 2, 3, 7, 7, 7, 7, 7]])
+    )
+
+
 def test_rolled_window_cache_long_generation():
     """A sliding-window model generating far past both its window and
     its trained max_len: the decode carry holds O(window) K/V (the

@@ -91,11 +91,16 @@ print("scratch resume: tpu-landed groups kept:", sorted(gb))
 PY
     )
   fi
+  # wall 900s per pass, NOT the full sweep: the healthy window is ~20
+  # min total and decode evidence (step 3) must get its turn. The
+  # pounce refires this script every healthy probe; the shared scratch
+  # means each pass completes only the still-missing groups, so a long
+  # window converges across passes.
   (cd "$WT" && \
     MMLTPU_BENCH_SCRATCH=/tmp/bench_r5_scratch.json \
     MMLTPU_BENCH_PROBE_WINDOW_S=90 \
-    MMLTPU_BENCH_WALL_S=3300 \
-    timeout 3600 python bench.py | tail -n 1 > /tmp/bench_r5_line.json)
+    MMLTPU_BENCH_WALL_S=900 \
+    timeout 1100 python bench.py | tail -n 1 > /tmp/bench_r5_line.json)
   python - <<'PY'
 import json, sys
 line = json.load(open("/tmp/bench_r5_line.json"))
