@@ -2,7 +2,7 @@
 and model zoo. See :mod:`mmlspark_tpu.models.graph` for the cut-at-node
 abstraction mirroring the reference's CNTK graph surgery."""
 
-from mmlspark_tpu.models.generate import generate  # noqa: F401
+from mmlspark_tpu.models.generate import beam_search, generate  # noqa: F401
 from mmlspark_tpu.models.graph import FINAL_NODE, NamedGraph  # noqa: F401
 from mmlspark_tpu.models.registry import (  # noqa: F401
     build_model,

@@ -105,6 +105,38 @@ def _roll_prefill_cache(cache, p: int, window: int) -> dict:
     return out
 
 
+def _validate_causal_decode(graph, prompt, max_new_tokens: int):
+    """Shared decode-entry validation (generate() AND beam_search()):
+    causal contract, token budget, and the learned-position-table cap.
+    Returns (prompt int32, B, P, total)."""
+    if not graph.extra.get("causal", False):
+        raise FriendlyError(
+            f"decoding needs a causal LM; '{graph.name}' has "
+            "causal=False (bidirectional logits leak future positions)"
+        )
+    if max_new_tokens < 1:
+        raise FriendlyError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_len = graph.input_shape[0] if graph.input_shape else None
+    if (
+        max_len
+        and total > max_len
+        and graph.extra.get("pos_embedding", "learned") == "learned"
+    ):
+        # the learned position table caps the buffer; RoPE models
+        # extrapolate structurally and may generate past max_len
+        raise FriendlyError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the learned position table ({max_len}); build the model "
+            "with a larger max_len or pos_embedding='rope'"
+        )
+    return prompt, b, p, total
+
+
 def generate(graph, variables, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int | None = None,
              top_p: float | None = None, rng=None, pad_id: int = 0,
@@ -130,11 +162,9 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
     (per-token cost independent of generated length); ``False`` uses the
     O(T²) full-recompute oracle — both produce the same tokens.
     """
-    if not graph.extra.get("causal", False):
-        raise FriendlyError(
-            f"generate() needs a causal LM; '{graph.name}' has "
-            "causal=False (bidirectional logits leak future positions)"
-        )
+    prompt, b, p, total = _validate_causal_decode(
+        graph, prompt, max_new_tokens
+    )
     if graph.extra.get("n_experts") and not kv_cache:
         # expert-capacity routing is NOT causal over the recompute
         # path's PAD-FILLED buffer: future pad positions would be routed
@@ -148,10 +178,6 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
             f"('{graph.name}'): capacity dispatch over the pad-filled "
             "recompute buffer is not causal; use the default kv_cache "
             "decode"
-        )
-    if max_new_tokens < 1:
-        raise FriendlyError(
-            f"max_new_tokens must be >= 1, got {max_new_tokens}"
         )
     if temperature < 0.0:
         raise FriendlyError(
@@ -173,22 +199,6 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
         )
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise FriendlyError(f"top_p must be in (0, 1], got {top_p}")
-    prompt = jnp.asarray(prompt, jnp.int32)
-    b, p = prompt.shape
-    total = p + max_new_tokens
-    max_len = graph.input_shape[0] if graph.input_shape else None
-    if (
-        max_len
-        and total > max_len
-        and graph.extra.get("pos_embedding", "learned") == "learned"
-    ):
-        # the learned position table caps the buffer; RoPE models
-        # extrapolate structurally and may generate past max_len
-        raise FriendlyError(
-            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"the learned position table ({max_len}); build the model "
-            "with a larger max_len or pos_embedding='rope'"
-        )
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused on the greedy path
 
@@ -288,3 +298,126 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
         length=max_new_tokens,
     )
     return buf
+
+
+def beam_search(graph, variables, prompt, max_new_tokens: int, *,
+                beams: int = 4, eos_id: int | None = None,
+                pad_id: int = 0, length_penalty: float = 0.0,
+                return_all: bool = False):
+    """Beam-search decode over the KV cache (always cached — beams make
+    the O(T²) recompute path K times worse, so it is not offered).
+
+    Static-shape throughout: B·K sequences decode as one batch, each
+    step scores (B, K, V) candidates, takes the top K over the flattened
+    K·V axis, and REORDERS the per-block K/V buffers by the surviving
+    beams' parent indices (a batch-dim gather inside the same jitted
+    scan). Finished beams (``eos_id``) emit ``pad_id`` at frozen score.
+
+    ``length_penalty`` alpha divides final scores by ``gen_len**alpha``
+    (0 = plain sum of log-probs). Returns the best (B, P+N) buffer, or
+    with ``return_all`` a tuple of ((B, K, P+N) sequences sorted by the
+    search, (B, K) adjusted scores).
+
+    Works with every cached-decode configuration: GQA, RoPE, sliding
+    window (rolled buffers reorder the same way), and MoE (dropless
+    decode routing).
+    """
+    prompt, b, p, total = _validate_causal_decode(
+        graph, prompt, max_new_tokens
+    )
+    if beams < 1:
+        raise FriendlyError(f"beams must be >= 1, got {beams}")
+    vocab = graph.extra.get("vocab_size")
+    if vocab and beams > vocab:
+        # cheap pre-check BEFORE the prefill forward compiles/runs
+        raise FriendlyError(
+            f"beams ({beams}) cannot exceed vocab_size ({vocab})"
+        )
+    if length_penalty < 0.0:
+        raise FriendlyError(
+            f"length_penalty must be >= 0, got {length_penalty}"
+        )
+    n = max_new_tokens
+    k = beams
+    window = graph.extra.get("window")
+    rolled = bool(window) and window < total
+
+    # -- prefill once at batch B, then tile the cache to B*K beams --------
+    cache = init_cache(graph, variables, b, p if rolled else total)
+    logits, cache = _cached_apply(graph, variables, prompt, cache, 0)
+    if rolled:
+        cache = _roll_prefill_cache(cache, p, window)
+    logprobs = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+    vocab = logprobs.shape[-1]
+    if k > vocab:  # builders without vocab metadata reach here instead
+        raise FriendlyError(
+            f"beams ({k}) cannot exceed vocab_size ({vocab})"
+        )
+    scores, tok0 = jax.lax.top_k(logprobs, k)  # (B, K) each
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, k, axis=0), cache
+    )
+    buf = jnp.full((b, k, n), pad_id, jnp.int32)
+    buf = buf.at[:, :, 0].set(tok0)
+    done = (
+        tok0 == eos_id if eos_id is not None
+        else jnp.zeros((b, k), bool)
+    )
+    gen_len = jnp.ones((b, k), jnp.int32)
+
+    if n > 1:
+        # finished beams may only extend with pad at zero added score
+        pad_only = jnp.full((vocab,), float("-inf"), jnp.float32)
+        pad_only = pad_only.at[pad_id].set(0.0)
+
+        def step(carry, i):
+            buf, tok, scores, done, gen_len, cache = carry
+            logits, cache = _cached_apply(
+                graph, variables, tok.reshape(b * k, 1), cache,
+                p + i - 1, rolled=rolled, step=True,
+            )
+            lp = jax.nn.log_softmax(
+                logits[:, 0].astype(jnp.float32)
+            ).reshape(b, k, vocab)
+            lp = jnp.where(done[..., None], pad_only, lp)
+            cand = (scores[..., None] + lp).reshape(b, k * vocab)
+            scores, idx = jax.lax.top_k(cand, k)  # (B, K)
+            parent = idx // vocab
+            token = (idx % vocab).astype(jnp.int32)
+            # reorder every per-beam quantity by the surviving parents
+            buf = jnp.take_along_axis(buf, parent[..., None], axis=1)
+            done = jnp.take_along_axis(done, parent, axis=1)
+            gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+            flat = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+            cache = jax.tree_util.tree_map(lambda a: a[flat], cache)
+            buf = jax.lax.dynamic_update_slice(
+                buf, token[..., None], (0, 0, i)
+            )
+            gen_len = gen_len + (~done).astype(jnp.int32)
+            if eos_id is not None:
+                done = done | (token == eos_id)
+            return (buf, token, scores, done, gen_len, cache), None
+
+        (buf, _, scores, done, gen_len, _), _ = jax.lax.scan(
+            step, (buf, tok0, scores, done, gen_len, cache),
+            jnp.arange(1, n),
+        )
+
+    adjusted = scores
+    if length_penalty > 0.0:
+        adjusted = scores / jnp.maximum(
+            gen_len.astype(jnp.float32), 1.0
+        ) ** length_penalty
+    seqs = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None], (b, k, p)), buf], axis=2
+    )
+    if return_all:
+        order = jnp.argsort(-adjusted, axis=1)
+        return (
+            jnp.take_along_axis(seqs, order[..., None], axis=1),
+            jnp.take_along_axis(adjusted, order, axis=1),
+        )
+    best = jnp.argmax(adjusted, axis=1)  # (B,)
+    return jnp.take_along_axis(
+        seqs, best[:, None, None], axis=1
+    )[:, 0]

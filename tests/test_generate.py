@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from mmlspark_tpu.core.exceptions import FriendlyError
-from mmlspark_tpu.models import build_model, generate
+from mmlspark_tpu.models import beam_search, build_model, generate
 
 PERIOD = 4  # token stream cycles 1,2,3,4,1,2,...
 
@@ -205,3 +205,86 @@ def test_generate_rejects_moe_recompute_and_negative_temperature():
     with pytest.raises(FriendlyError, match="kv_cache"):
         generate(moe, mv, jnp.zeros((1, 4), jnp.int32), max_new_tokens=2,
                  kv_cache=False)
+
+
+# -- beam search ------------------------------------------------------------
+
+
+def test_beam_one_equals_greedy():
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=32, window=6)
+    v, ids = _train_lm(m, steps=30)
+    prompt = ids[:, :5]
+    greedy = np.asarray(generate(m, v, prompt, max_new_tokens=9))
+    beam1 = np.asarray(beam_search(m, v, prompt, max_new_tokens=9,
+                                   beams=1))
+    np.testing.assert_array_equal(beam1, greedy)
+
+
+def test_beam_full_width_is_exhaustive_at_two_steps():
+    """With K = V beams and N = 2 steps, beam search IS exhaustive: step
+    1 keeps every first token, step 2 scores all V² continuations. The
+    best beam must therefore equal the brute-force argmax of the
+    teacher-forced log-prob sum over all V² sequences — on an untrained
+    model whose greedy path has no reason to be globally optimal."""
+    V = 6
+    m = build_model("transformer_lm", vocab_size=V, d_model=16, heads=2,
+                    depth=1, max_len=12)
+    v = m.init(jax.random.PRNGKey(4), jnp.zeros((1, 4), jnp.int32))
+    prompt = jnp.asarray([[1, 2, 3, 4], [5, 0, 1, 2]], jnp.int32)
+    b, p = prompt.shape
+    got = np.asarray(beam_search(m, v, prompt, max_new_tokens=2, beams=V))
+
+    # brute force: score every (t1, t2) continuation teacher-forced
+    cands = np.stack(np.meshgrid(np.arange(V), np.arange(V),
+                                 indexing="ij"), -1).reshape(-1, 2)
+    best = np.zeros((b, 2), np.int32)
+    for row in range(b):
+        seqs = np.concatenate(
+            [np.tile(np.asarray(prompt[row])[None], (V * V, 1)), cands],
+            axis=1,
+        )
+        lg = np.asarray(m.apply(v, jnp.asarray(seqs)), np.float32)
+        lp = jax.nn.log_softmax(jnp.asarray(lg), axis=-1)
+        lp = np.asarray(lp)
+        scores = (
+            lp[np.arange(V * V), p - 1, cands[:, 0]]
+            + lp[np.arange(V * V), p, cands[:, 1]]
+        )
+        best[row] = cands[scores.argmax()]
+    np.testing.assert_array_equal(got[:, p:], best)
+
+
+def test_beam_eos_and_return_all():
+    m = build_model("transformer_lm", vocab_size=8, d_model=32, heads=2,
+                    depth=2, max_len=32)
+    v, ids = _train_lm(m)
+    prompt = ids[:, :8]
+    out = np.asarray(beam_search(m, v, prompt, max_new_tokens=8,
+                                 beams=3, eos_id=3))
+    want = np.concatenate([np.asarray(prompt)[0],
+                           [1, 2, 3, 0, 0, 0, 0, 0]])
+    np.testing.assert_array_equal(out[0], want)
+    seqs, scores = beam_search(m, v, prompt, max_new_tokens=4, beams=3,
+                               return_all=True)
+    assert seqs.shape == (1, 3, 12) and scores.shape == (1, 3)
+    s = np.asarray(scores)
+    assert np.all(s[:, :-1] >= s[:, 1:])  # sorted best-first
+    np.testing.assert_array_equal(np.asarray(seqs)[0, 0, :8],
+                                  np.asarray(prompt)[0])
+
+
+def test_beam_guards_and_moe():
+    m = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
+                    depth=1, max_len=16)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(FriendlyError, match="beams"):
+        beam_search(m, v, prompt, max_new_tokens=2, beams=0)
+    with pytest.raises(FriendlyError, match="vocab"):
+        beam_search(m, v, prompt, max_new_tokens=2, beams=9)
+    moe = build_model("transformer_lm_moe", vocab_size=8, d_model=16,
+                      heads=2, depth=1, max_len=16, n_experts=2)
+    mv = moe.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    out = beam_search(moe, mv, prompt, max_new_tokens=3, beams=2)
+    assert out.shape == (1, 7)
