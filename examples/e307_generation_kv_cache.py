@@ -16,7 +16,10 @@ the full decode story on the causal LM family:
 4. nucleus/top-k sampling: temperature sampling with `top_p` truncation
    still follows the learned period on a peaked model (the nucleus
    collapses to the top token), while loose filters reproduce the
-   unfiltered stream rng-for-rng.
+   unfiltered stream rng-for-rng;
+5. beam search over the same cache: `beams=1` reproduces greedy
+   exactly and wider beams return score-sorted alternatives (eos beam
+   freezing is covered by the unit suite, tests/test_generate.py).
 
 Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
      python examples/e307_generation_kv_cache.py
@@ -24,7 +27,7 @@ Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 import numpy as np
 
-from mmlspark_tpu.models import build_model, generate
+from mmlspark_tpu.models import beam_search, build_model, generate
 
 VOCAB = 8
 PERIOD = 4  # stream cycles 1,2,3,4,1,2,...
@@ -101,10 +104,20 @@ def main():
     )
     assert (base == loose).all()
 
+    # -- 5. beam search over the same cache --------------------------------
+    beam1 = np.asarray(beam_search(m, v, prompt, max_new_tokens=16,
+                                   beams=1))
+    np.testing.assert_array_equal(beam1, kv)  # beams=1 == greedy
+    seqs, scores = beam_search(m, v, prompt, max_new_tokens=8, beams=4,
+                               return_all=True)
+    s = np.asarray(scores)
+    assert seqs.shape == (1, 4, 16) and np.all(s[:, :-1] >= s[:, 1:])
+
     print(
         f"OK {{'kv_matches_oracle': True, "
         f"'rolled_window_tokens': {LONG}, "
-        f"'window': 8, 'nucleus_follows_period': True}}"
+        f"'window': 8, 'nucleus_follows_period': True, "
+        f"'beam1_equals_greedy': True}}"
     )
 
 
