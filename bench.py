@@ -114,6 +114,7 @@ _GROUPS = {
     "flash_long": ("flash_long",),
     "int8_serving": ("int8_serving",),
     "feed_synth": ("feed_synth",),
+    "decode": ("decode",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -636,6 +637,82 @@ def bench_int8_serving(jax, jnp) -> dict:
     }
 
 
+def bench_decode(jax, jnp) -> dict:
+    """KV-cache decode vs the O(T²) recompute oracle (VERDICT r4 next
+    #3): whole generate() jitted (prefill + lax.scan in one program, so
+    relay dispatch is paid once per call), per-token seconds from the
+    DIFFERENCE of two generation lengths — fixed costs (prefill,
+    dispatch, host sync) cancel, leaving the marginal cost of one
+    decode step. Both paths run attn_impl='dense' so the ratio isolates
+    the cache machinery."""
+    from mmlspark_tpu.models import build_model, generate
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 32, 2, 2)
+    )
+    b, p = (8, 64) if full else (2, 8)
+    n_short, n_long = (64, 256) if full else (4, 12)
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=p + n_long, attn_impl="dense",
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, p), jnp.int32)
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, vocab, size=(b, p)), jnp.int32
+    )
+    # weights as a jit ARGUMENT, not a closure constant: four programs
+    # each baking tens of MB of parameters in as XLA constants would
+    # multiply compile memory and relay transfer inside the scarce
+    # tunnel window
+    jitted = {
+        (n, kv): jax.jit(
+            lambda v, pr, n=n, kv=kv: generate(
+                graph, v, pr, n, kv_cache=kv
+            )
+        )
+        for n in (n_short, n_long)
+        for kv in (True, False)
+    }
+    out: dict = {}
+    per_tok_s = {}
+    for name, kv in (("kv_cache", True), ("recompute", False)):
+        f_short, f_long = jitted[(n_short, kv)], jitted[(n_long, kv)]
+        np.asarray(f_short(variables, prompt))  # compile
+        np.asarray(f_long(variables, prompt))
+        t_short = min(
+            _timed(lambda: np.asarray(f_short(variables, prompt)))
+            for _ in range(3)
+        )
+        t_long = min(
+            _timed(lambda: np.asarray(f_long(variables, prompt)))
+            for _ in range(3)
+        )
+        delta = t_long - t_short
+        fallback = delta <= 0  # noise swallowed the chained delta
+        per_tok = (
+            t_long / n_long if fallback else delta / (n_long - n_short)
+        )
+        per_tok_s[name] = per_tok
+        out[name] = {
+            "per_token_ms": round(per_tok * 1e3, 4),
+            "tokens_per_sec_batch": round(b / per_tok, 1),
+            "noise_fallback": fallback,
+        }
+    out["kv_vs_recompute_speedup"] = round(
+        per_tok_s["recompute"] / per_tok_s["kv_cache"], 2
+    )
+    out["model"] = {"vocab": vocab, "d_model": d_model, "heads": heads,
+                    "depth": depth, "batch": b, "prompt": p,
+                    "n_short": n_short, "n_long": n_long}
+    out["timing"] = ("whole generate() jitted; per-token = "
+                     "(t(n_long) - t(n_short)) / (n_long - n_short), "
+                     "best-of-3, host-fetch sync")
+    return {"decode": out}
+
+
 def bench_feed_synth() -> dict:
     """Feed-machinery overhead bound WITHOUT the relay (VERDICT r4 next
     #7): tools/feed_overhead_bench.py re-execs onto the CPU backend
@@ -1068,6 +1145,7 @@ def run(attempt: int) -> dict:
         "train": lambda: bench_train_classifier(jax),
         "trees": lambda: bench_trees(jax),
         "flash": lambda: bench_flash(jax, jnp),
+        "decode": lambda: bench_decode(jax, jnp),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),
