@@ -65,6 +65,8 @@ def cmd_evidence(args) -> int:
     script = {
         "flash": "flash_tpu_evidence.py",
         "profile": "profile_resnet50.py",
+        "decode": "decode_tpu_evidence.py",
+        "feed": "feed_overhead_bench.py",
     }[args.which]
     path = os.path.join(repo, "tools", script)
     if not os.path.exists(path):
@@ -149,9 +151,10 @@ def main(argv: list[str] | None = None) -> int:
     sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser(
-        "evidence", help="run a TPU evidence tool (flash | profile)"
+        "evidence",
+        help="run a TPU evidence tool (flash | profile | decode | feed)",
     )
-    sp.add_argument("which", choices=["flash", "profile"])
+    sp.add_argument("which", choices=["flash", "profile", "decode", "feed"])
     sp.add_argument("tool_args", nargs=argparse.REMAINDER)
     sp.set_defaults(fn=cmd_evidence)
 
