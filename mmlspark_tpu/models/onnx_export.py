@@ -293,6 +293,27 @@ def _gelu_nodes(prefix, x_name, out_name, nodes):
     ]
 
 
+def _rope_nodes(prefix, x_name, out_name, nodes):
+    """Rotate-half RoPE on a (B, S, H, D) tensor against the rope_cos /
+    rope_sin constants — exactly ops/rope.py apply_rope: out =
+    concat(x1·cos − x2·sin, x1·sin + x2·cos) over the last-dim halves."""
+    p = prefix
+    nodes += [
+        node("Slice", [x_name, "rope_st0", "rope_mid", "rope_axes"],
+             [f"{p}_a"], name=f"{p}_a"),
+        node("Slice", [x_name, "rope_mid", "rope_end", "rope_axes"],
+             [f"{p}_b"], name=f"{p}_b"),
+        node("Mul", [f"{p}_a", "rope_cos"], [f"{p}_ac"], name=f"{p}_ac"),
+        node("Mul", [f"{p}_b", "rope_sin"], [f"{p}_bs"], name=f"{p}_bs"),
+        node("Sub", [f"{p}_ac", f"{p}_bs"], [f"{p}_lo"], name=f"{p}_lo"),
+        node("Mul", [f"{p}_a", "rope_sin"], [f"{p}_as"], name=f"{p}_as"),
+        node("Mul", [f"{p}_b", "rope_cos"], [f"{p}_bc"], name=f"{p}_bc"),
+        node("Add", [f"{p}_as", f"{p}_bc"], [f"{p}_hi"], name=f"{p}_hi"),
+        node("Concat", [f"{p}_lo", f"{p}_hi"], [out_name], name=out_name,
+             attrs=[attr_i("axis", 3)]),
+    ]
+
+
 def _export_transformer_lm(graph, variables, sample_shape):
     """Decoder/encoder transformer -> primitive-op ONNX. Block outputs are
     named ``block{i}`` and the logits node ``z`` (= graph.layer_names), so
@@ -301,12 +322,11 @@ def _export_transformer_lm(graph, variables, sample_shape):
     extra = graph.extra
     causal = bool(extra.get("causal", True))
     emb = _np(variables["embed"], "params", "token", "embedding")
-    if extra.get("pos_embedding") == "rope":
-        raise FriendlyError(
-            "transformer_lm ONNX export does not support RoPE yet "
-            "(pos_embedding='rope'); export a learned-position model"
-        )
-    pos = _np(variables["embed"], "params", "pos")[:seq]
+    rope = extra.get("pos_embedding") == "rope"
+    # RoPE models have no learned position table: position enters as
+    # the in-graph rotate-half of q/k against (1, S, 1, D/2) cos/sin
+    # constants for THIS export length (r5; ops/rope.py is the contract)
+    pos = None if rope else _np(variables["embed"], "params", "pos")[:seq]
     d_model = emb.shape[1]
     blocks = [n for n in graph.layer_names if n.startswith("block")]
     if not blocks:
@@ -337,7 +357,6 @@ def _export_transformer_lm(graph, variables, sample_shape):
     nodes, inits = [], []
     inits += [
         tensor_proto("embedding", emb),
-        tensor_proto("pos", pos),
         tensor_proto("ln_eps", np.array(1e-6, np.float32)),
         tensor_proto("one", np.array(1.0, np.float32)),
         tensor_proto("half", np.array(0.5, np.float32)),
@@ -357,6 +376,29 @@ def _export_transformer_lm(graph, variables, sample_shape):
         ),
         tensor_proto("sl_axes", np.array([2], np.int64)),
     ]
+    if pos is not None:
+        inits.append(tensor_proto("pos", pos))
+    if rope:
+        half = head_dim // 2
+        inv_freq = 10000.0 ** (
+            -np.arange(half, dtype=np.float32) / half
+        )
+        ang = np.arange(seq, dtype=np.float32)[:, None] * inv_freq[None, :]
+        inits += [
+            tensor_proto(
+                "rope_cos",
+                np.cos(ang).astype(np.float32).reshape(1, seq, 1, half),
+            ),
+            tensor_proto(
+                "rope_sin",
+                np.sin(ang).astype(np.float32).reshape(1, seq, 1, half),
+            ),
+            tensor_proto("rope_st0", np.array([0], np.int64)),
+            tensor_proto("rope_mid", np.array([half], np.int64)),
+            tensor_proto("rope_end", np.array([head_dim], np.int64)),
+            tensor_proto("rope_axes", np.array([3], np.int64)),
+        ]
+    window = extra.get("window")
     if causal:
         # the (T, T) additive mask is synthesized IN-GRAPH from two O(T)
         # position vectors — clip(relu(j - i), 0, 1) is exactly 1 above
@@ -369,21 +411,44 @@ def _export_transformer_lm(graph, variables, sample_shape):
             tensor_proto("zero", np.array(0.0, np.float32)),
             tensor_proto("neg_big", np.array(-1e9, np.float32)),
         ]
+        cau_out = "mask_cau" if window else "causal_mask"
         nodes += [
             node("Sub", ["pos_col", "pos_row"], ["mask_d"], name="mask_d"),
             node("Relu", ["mask_d"], ["mask_r"], name="mask_r"),
             node("Clip", ["mask_r", "zero", "one"], ["mask_c"],
                  name="mask_c"),
-            node("Mul", ["mask_c", "neg_big"], ["causal_mask"],
-                 name="causal_mask"),
+            node("Mul", ["mask_c", "neg_big"], [cau_out], name=cau_out),
         ]
+        if window:
+            # sliding window: keys older than qpos - W + 1 die too —
+            # clip(relu((i - j) - (W-1)), 0, 1) is 1 exactly where
+            # i - j >= W, the dense_attention window contract
+            inits.append(tensor_proto(
+                "win_off", np.array(float(window) - 1.0, np.float32)
+            ))
+            nodes += [
+                node("Sub", ["pos_row", "pos_col"], ["win_d"],
+                     name="win_d"),
+                node("Sub", ["win_d", "win_off"], ["win_o"],
+                     name="win_o"),
+                node("Relu", ["win_o"], ["win_r"], name="win_r"),
+                node("Clip", ["win_r", "zero", "one"], ["win_c"],
+                     name="win_c"),
+                node("Mul", ["win_c", "neg_big"], ["win_mask"],
+                     name="win_mask"),
+                node("Add", ["mask_cau", "win_mask"], ["causal_mask"],
+                     name="causal_mask"),
+            ]
 
-    nodes += [
+    nodes.append(
         node("Gather", ["embedding", "x"], ["tok"], name="tok",
-             attrs=[attr_i("axis", 0)]),
-        node("Add", ["tok", "pos"], ["embed"], name="embed"),
-    ]
-    prev = "embed"
+             attrs=[attr_i("axis", 0)])
+    )
+    if pos is not None:
+        nodes.append(node("Add", ["tok", "pos"], ["embed"], name="embed"))
+        prev = "embed"
+    else:
+        prev = "tok"  # RoPE: position lives in the attention rotation
     for bi, blk in enumerate(blocks):
         params = variables[blk]["params"]
         p = blk
@@ -418,10 +483,15 @@ def _export_transformer_lm(graph, variables, sample_shape):
                 node("Reshape", [f"{p}_{nm}f", "shape_split"],
                      [f"{p}_{nm}s"], name=f"{p}_{nm}s"),
             ]
+        q_in, k_in = f"{p}_qs", f"{p}_ks"
+        if rope:
+            _rope_nodes(f"{p}_rq", q_in, f"{p}_qr", nodes)
+            _rope_nodes(f"{p}_rk", k_in, f"{p}_kr", nodes)
+            q_in, k_in = f"{p}_qr", f"{p}_kr"
         nodes += [
-            node("Transpose", [f"{p}_qs"], [f"{p}_qh"], name=f"{p}_qh",
+            node("Transpose", [q_in], [f"{p}_qh"], name=f"{p}_qh",
                  attrs=[attr_ints("perm", [0, 2, 1, 3])]),
-            node("Transpose", [f"{p}_ks"], [f"{p}_kT"], name=f"{p}_kT",
+            node("Transpose", [k_in], [f"{p}_kT"], name=f"{p}_kT",
                  attrs=[attr_ints("perm", [0, 2, 3, 1])]),
             node("Transpose", [f"{p}_vs"], [f"{p}_vh"], name=f"{p}_vh",
                  attrs=[attr_ints("perm", [0, 2, 1, 3])]),
