@@ -22,7 +22,9 @@ Supported families: ``linear`` / ``mlp`` (Gemm + Relu chains),
 and ``transformer_lm`` (decomposed LayerNorm / multi-head attention /
 tanh-gelu in primitive ops; block outputs keep the flax layer names so
 named-node cuts survive the round trip, and the causal mask is built
-in-graph from O(T) position vectors). Convolutional families persist via
+in-graph from O(T) position vectors — with the window leg when the
+model slides, RoPE as in-graph rotate-half, and GQA's narrow K/V
+expanded via Reshape/Expand). Convolutional families persist via
 the native stage format (core/serialize); their ONNX export is
 intentionally out of scope.
 """
@@ -331,28 +333,23 @@ def _export_transformer_lm(graph, variables, sample_shape):
     blocks = [n for n in graph.layer_names if n.startswith("block")]
     if not blocks:
         raise FriendlyError("transformer_lm export needs depth >= 1")
-    # head count: qkv kernel is (E, 3·H·D) with E = H·D
-    hd3 = _np(
-        variables[blocks[0]], "params", "attn", "qkv", "kernel"
-    ).shape[1]
-    if hd3 != 3 * d_model:
-        if extra.get("kv_heads"):
-            # (h + 2*hk)*d layout — exporting would need in-graph K/V
-            # head expansion; reject with the real reason
-            raise FriendlyError(
-                "transformer_lm ONNX export does not support "
-                f"grouped-query attention yet (kv_heads="
-                f"{extra['kv_heads']}); export an MHA model"
-            )
-        raise FriendlyError(
-            f"qkv kernel must be (E, 3E); got 3HD={hd3} for E={d_model}"
-        )
     heads = int(extra.get("heads", 0))
     if not heads:
         raise FriendlyError(
             "transformer_lm export needs the head count in graph.extra"
         )
     head_dim = d_model // heads
+    # GQA-aware qkv layout: (E, (H + 2·Hkv)·D); MHA is Hkv == H
+    kv_heads = int(extra.get("kv_heads") or heads)
+    group = heads // kv_heads
+    hd3 = _np(
+        variables[blocks[0]], "params", "attn", "qkv", "kernel"
+    ).shape[1]
+    if hd3 != (heads + 2 * kv_heads) * head_dim:
+        raise FriendlyError(
+            f"qkv kernel must be (E, (H+2Hkv)·D); got {hd3} for "
+            f"H={heads} Hkv={kv_heads} D={head_dim}"
+        )
 
     nodes, inits = [], []
     inits += [
@@ -376,6 +373,25 @@ def _export_transformer_lm(graph, variables, sample_shape):
         ),
         tensor_proto("sl_axes", np.array([2], np.int64)),
     ]
+    if group > 1:
+        # grouped-query expansion shapes: narrow (B,S,Hkv,D) K/V gain a
+        # broadcast group axis then flatten to (B,S,H,D) — kv head
+        # i//group per query head i, jnp.repeat's exact layout
+        inits += [
+            tensor_proto(
+                "shape_kv",
+                np.array([batch, seq, kv_heads, head_dim], np.int64),
+            ),
+            tensor_proto(
+                "shape_kv5",
+                np.array([batch, seq, kv_heads, 1, head_dim], np.int64),
+            ),
+            tensor_proto(
+                "kv_expand",
+                np.array([batch, seq, kv_heads, group, head_dim],
+                         np.int64),
+            ),
+        ]
     if pos is not None:
         inits.append(tensor_proto("pos", pos))
     if rope:
@@ -454,7 +470,8 @@ def _export_transformer_lm(graph, variables, sample_shape):
         p = blk
         _ln_nodes(f"{p}_ln1", prev, f"{p}_y1", nodes, inits,
                   _np(params, "ln1", "scale"), _np(params, "ln1", "bias"))
-        # qkv projection + per-head split (q|k|v are contiguous thirds)
+        # qkv projection + per-head split (contiguous q: H·D then
+        # k and v: Hkv·D each — thirds only in the MHA case)
         inits += [
             tensor_proto(f"{p}_qkv_w", _np(params, "attn", "qkv", "kernel")),
             tensor_proto(f"{p}_qkv_b", _np(params, "attn", "qkv", "bias")),
@@ -469,8 +486,19 @@ def _export_transformer_lm(graph, variables, sample_shape):
             node("Add", [f"{p}_qkv0", f"{p}_qkv_b"], [f"{p}_qkv"],
                  name=f"{p}_qkv"),
         ]
-        for j, nm in enumerate(("q", "k", "v")):
-            lo, hi = j * d_model, (j + 1) * d_model
+        b0 = heads * head_dim
+        b1 = b0 + kv_heads * head_dim
+        b2 = b1 + kv_heads * head_dim
+        # k/v land on the NARROW (B,S,Hkv,D) shape first: RoPE (when
+        # enabled) rotates there — the (1,S,1,D/2) constants broadcast
+        # over any head count, and rotating before the group expansion
+        # is what flax does (rotation is group-times cheaper)
+        kv_shape = "shape_split" if group == 1 else "shape_kv"
+        for nm, lo, hi, shp in (
+            ("q", 0, b0, "shape_split"),
+            ("k", b0, b1, kv_shape),
+            ("v", b1, b2, kv_shape),
+        ):
             inits += [
                 tensor_proto(f"{p}_{nm}_st", np.array([lo], np.int64)),
                 tensor_proto(f"{p}_{nm}_en", np.array([hi], np.int64)),
@@ -480,20 +508,31 @@ def _export_transformer_lm(graph, variables, sample_shape):
                      [f"{p}_qkv", f"{p}_{nm}_st", f"{p}_{nm}_en",
                       "sl_axes"],
                      [f"{p}_{nm}f"], name=f"{p}_{nm}f"),
-                node("Reshape", [f"{p}_{nm}f", "shape_split"],
+                node("Reshape", [f"{p}_{nm}f", shp],
                      [f"{p}_{nm}s"], name=f"{p}_{nm}s"),
             ]
-        q_in, k_in = f"{p}_qs", f"{p}_ks"
+        q_in, k_in, v_in = f"{p}_qs", f"{p}_ks", f"{p}_vs"
         if rope:
             _rope_nodes(f"{p}_rq", q_in, f"{p}_qr", nodes)
             _rope_nodes(f"{p}_rk", k_in, f"{p}_kr", nodes)
             q_in, k_in = f"{p}_qr", f"{p}_kr"
+        if group > 1:
+            for nm, src in (("k", k_in), ("v", v_in)):
+                nodes += [
+                    node("Reshape", [src, "shape_kv5"],
+                         [f"{p}_{nm}5"], name=f"{p}_{nm}5"),
+                    node("Expand", [f"{p}_{nm}5", "kv_expand"],
+                         [f"{p}_{nm}e"], name=f"{p}_{nm}e"),
+                    node("Reshape", [f"{p}_{nm}e", "shape_split"],
+                         [f"{p}_{nm}x"], name=f"{p}_{nm}x"),
+                ]
+            k_in, v_in = f"{p}_kx", f"{p}_vx"
         nodes += [
             node("Transpose", [q_in], [f"{p}_qh"], name=f"{p}_qh",
                  attrs=[attr_ints("perm", [0, 2, 1, 3])]),
             node("Transpose", [k_in], [f"{p}_kT"], name=f"{p}_kT",
                  attrs=[attr_ints("perm", [0, 2, 3, 1])]),
-            node("Transpose", [f"{p}_vs"], [f"{p}_vh"], name=f"{p}_vh",
+            node("Transpose", [v_in], [f"{p}_vh"], name=f"{p}_vh",
                  attrs=[attr_ints("perm", [0, 2, 1, 3])]),
             node("MatMul", [f"{p}_qh", f"{p}_kT"], [f"{p}_sc0"],
                  name=f"{p}_sc0"),

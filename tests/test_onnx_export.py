@@ -171,3 +171,21 @@ def test_transformer_lm_window_round_trip(rng):
         # flip a token or two between the bf16 flax model and the f32
         # export
         assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85, pos_mode
+
+
+def test_transformer_lm_gqa_round_trip(rng):
+    """GQA export (r5): narrow K/V slices expand in-graph (Reshape →
+    Expand → Reshape = jnp.repeat's kv-head-per-group layout); combined
+    with RoPE to cover the full serving configuration."""
+    B, T = 2, 8
+    g = build_model(
+        "transformer_lm", vocab_size=32, d_model=16, heads=4, depth=2,
+        max_len=T, attn_impl="dense", kv_heads=2, pos_embedding="rope",
+    )
+    v = g.init(jax.random.PRNGKey(6), jnp.zeros((1, T), jnp.int32))
+    ids = rng.integers(0, 32, size=(B, T)).astype(np.int32)
+    want = np.asarray(g.apply(v, jnp.asarray(ids)))
+    g2 = load_onnx(export_onnx(g, v, (B, T)))
+    got = np.asarray(g2.apply(g2.init(), jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
