@@ -357,8 +357,9 @@ def test_rope_preserves_norm_and_dtype():
 
 def test_transformer_lm_rope():
     """pos_embedding='rope': no learned position table in the params,
-    forward+grad runs, and the ONNX exporter rejects with the reason."""
-    from mmlspark_tpu.core.exceptions import FriendlyError, ParamError
+    forward+grad runs, and the ONNX exporter handles it (r5 — full
+    round-trip parity lives in tests/test_onnx_export.py)."""
+    from mmlspark_tpu.core.exceptions import ParamError
     from mmlspark_tpu.models.onnx_export import export_onnx
     from mmlspark_tpu.models.registry import build_model
 
@@ -375,8 +376,7 @@ def test_transformer_lm_rope():
     g = jax.jit(jax.grad(loss))(vars_)
     assert jax.tree_util.tree_reduce(
         lambda a, b: a + float(jnp.sum(jnp.abs(b))), g, 0.0) > 0
-    with pytest.raises(FriendlyError, match="RoPE"):
-        export_onnx(m, vars_, (1, 16))
+    assert len(export_onnx(m, vars_, (1, 16))) > 0  # exports since r5
     with pytest.raises(ParamError, match="pos_embedding"):
         build_model("transformer_lm", vocab_size=32, d_model=16, heads=2,
                     depth=1, max_len=16, pos_embedding="alibi")
