@@ -1011,10 +1011,12 @@ def _probe_loop(attempt: int) -> tuple[bool, str]:
         )
     )
     # the probe window must leave room on the GLOBAL clock for backend
-    # init + at least the headline group (or, failing that, the CPU-smoke
-    # fallback) — a probe loop that runs to the driver's kill is how four
-    # rounds of BENCH_r*.json came back empty
-    window = min(window, max(60.0, _wall_remaining() - 420.0))
+    # init + at least the headline group (or, failing that, the FULL
+    # CPU-smoke sweep — ten groups now, ~6-8 min) — a probe loop that
+    # runs to the driver's kill is how four rounds of BENCH_r*.json came
+    # back empty. 40% of remaining wall per attempt keeps the total
+    # probing under half the budget across all three attempts.
+    window = min(window, max(60.0, 0.4 * _wall_remaining()))
     timeout = float(
         os.environ.get("MMLTPU_BENCH_PROBE_TIMEOUT_S", _PROBE_TIMEOUT_S)
     )
