@@ -175,3 +175,65 @@ def test_transformers_do_not_mutate_input(objects):
                 np.testing.assert_array_equal(
                     cur, old, err_msg=f"{name} mutated column {c}"
                 )
+
+
+def test_decode_api_fuzzing():
+    """Decode-surface fuzz (reference FuzzingTest philosophy applied to
+    the r5 generation API): random transformer_lm configs and random
+    generate()/beam_search() arguments must either work or raise the
+    framework's typed errors — never a bare TypeError/IndexError/
+    ZeroDivisionError from deep inside a trace."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.core.exceptions import FriendlyError, ParamError
+    from mmlspark_tpu.models import beam_search, build_model, generate
+
+    rng = np.random.default_rng(0)
+    built = 0
+    for _ in range(25):
+        cfg = dict(
+            vocab_size=int(rng.choice([4, 8, 16])),
+            d_model=int(rng.choice([8, 16])),
+            # weighted toward valid combos so the fuzz exercises real
+            # decodes, while still sampling every rejection class
+            heads=int(rng.choice([1, 2, 2, 2, 3])),
+            depth=int(rng.choice([1, 2])),
+            max_len=int(rng.choice([4, 12])),
+            causal=bool(rng.choice([True, True, True, False])),
+            window=[None, None, 1, 3, 0][rng.integers(0, 5)],
+            kv_heads=[None, None, 1, 2, 3][rng.integers(0, 5)],
+            pos_embedding=str(rng.choice(["learned", "rope"])),
+        )
+        try:
+            m = build_model("transformer_lm", attn_impl="dense", **cfg)
+        except (FriendlyError, ParamError):
+            continue  # invalid combo rejected with a typed error: pass
+        built += 1
+        v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+        prompt = jnp.asarray(
+            rng.integers(0, cfg["vocab_size"], size=(2, 3)), jnp.int32
+        )
+        n = int(rng.choice([0, 1, 5]))
+        kwargs = dict(
+            temperature=float(rng.choice([0.0, 0.7, -1.0])),
+            top_k=[None, 1, 99][rng.integers(0, 3)],
+            top_p=[None, 0.5, 2.0][rng.integers(0, 3)],
+            eos_id=[None, 1][rng.integers(0, 2)],
+            rng=jax.random.PRNGKey(1),
+        )
+        try:
+            out = generate(m, v, prompt, n, **kwargs)
+            assert out.shape == (2, 3 + n)
+        except (FriendlyError, ParamError):
+            pass
+        try:
+            bout = beam_search(
+                m, v, prompt, max(n, 1),
+                beams=int(rng.choice([0, 1, 2, 99])),
+                length_penalty=float(rng.choice([0.0, 0.6, -1.0])),
+            )
+            assert bout.shape[0] == 2
+        except (FriendlyError, ParamError):
+            pass
+    assert built >= 5  # the fuzz must actually exercise valid configs
