@@ -115,6 +115,7 @@ _GROUPS = {
     "int8_serving": ("int8_serving",),
     "feed_synth": ("feed_synth",),
     "decode": ("decode",),
+    "serve": ("serve",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -713,6 +714,30 @@ def bench_decode(jax, jnp) -> dict:
     return {"decode": out}
 
 
+def bench_serve(jax) -> dict:
+    """Continuous-batching serving demo (mmlspark_tpu.serve): synthetic
+    staggered traffic through the slot-pool engine, reporting TTFT,
+    per-token decode latency, slot utilization, and throughput — the
+    serving-plane complement to the per-call ``decode`` group. The fused
+    decode step must compile exactly once (``decode_compiles``); more
+    than one means the continuous-batching invariant broke on-chip."""
+    from mmlspark_tpu.serve.demo import run_demo
+
+    full = _full_scale(jax)
+    out = run_demo(
+        slots=4 if full else 2,
+        n_requests=16 if full else 4,
+        max_new_tokens=32 if full else 4,
+        arrivals_per_tick=2,
+        vocab=8192 if full else 64,
+        d_model=512 if full else 32,
+        heads=8 if full else 2,
+        depth=8 if full else 2,
+        cache_len=128 if full else 32,
+    )
+    return {"serve": out}
+
+
 def bench_feed_synth() -> dict:
     """Feed-machinery overhead bound WITHOUT the relay (VERDICT r4 next
     #7): tools/feed_overhead_bench.py re-execs onto the CPU backend
@@ -1148,6 +1173,7 @@ def run(attempt: int) -> dict:
         "trees": lambda: bench_trees(jax),
         "flash": lambda: bench_flash(jax, jnp),
         "decode": lambda: bench_decode(jax, jnp),
+        "serve": lambda: bench_serve(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),

@@ -9,6 +9,7 @@ JVM: it resolves the backend (real TPU vs CPU mesh), then dispatches.
 Subcommands:
   run <script.py> [args...]   run a user script (the spark-submit role)
   bench                       the repo benchmark (one JSON line)
+  serve                       continuous-batching serve demo (one JSON line)
   docgen [out_dir]            regenerate API docs (.rst + html)
   config                      print the resolved app config namespace
   env                         print the device/topology view
@@ -54,6 +55,24 @@ def cmd_bench(args) -> int:
               file=sys.stderr)
         return 2
     runpy.run_path(bench, run_name="__main__")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Continuous-batching serve demo: synthetic traffic through a
+    ``ServeEngine`` slot pool, ONE JSON metrics line out (mirrors
+    ``bench``)."""
+    _apply_backend(args)
+    from mmlspark_tpu.serve.demo import run_demo
+
+    metrics = run_demo(
+        slots=args.slots,
+        n_requests=args.requests,
+        max_new_tokens=args.max_new_tokens,
+        arrivals_per_tick=args.arrivals_per_tick,
+        seed=args.seed,
+    )
+    print(json.dumps(metrics, default=str))
     return 0
 
 
@@ -149,6 +168,22 @@ def main(argv: list[str] | None = None) -> int:
 
     sp = sub.add_parser("bench", help="run the repo benchmark")
     sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser(
+        "serve", help="continuous-batching serve demo (one JSON line)"
+    )
+    sp.add_argument(
+        "--demo", action="store_true",
+        help="run the synthetic-traffic demo (the only mode today)",
+    )
+    sp.add_argument("--slots", type=int, default=4,
+                    help="KV-cache pool slots (concurrent requests)")
+    sp.add_argument("--requests", type=int, default=8,
+                    help="synthetic requests to submit")
+    sp.add_argument("--max-new-tokens", type=int, default=8)
+    sp.add_argument("--arrivals-per-tick", type=int, default=2)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
         "evidence",

@@ -38,19 +38,49 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.models.graph import _accepts_kwarg
 
 
-def init_cache(graph, variables, batch: int, total: int) -> dict:
-    """Preallocated per-block K/V decode buffers, ``(B, total, hk, d)``
-    bf16 zeros for every block that takes a ``cache`` kwarg. The head
-    geometry is read off the fused qkv kernel so it stays correct for
-    any (heads, kv_heads, head_dim) build."""
-    h = graph.extra["heads"]
-    hk = graph.extra.get("kv_heads") or h
-    cache = {}
+def cache_geometry(graph, variables) -> dict:
+    """``{block name: (kv_heads, head_dim)}`` for every block that takes
+    a ``cache`` kwarg, read off the fused qkv kernel so it stays correct
+    for any (heads, kv_heads, head_dim) build. Shared by
+    :func:`init_cache` (per-call decode buffers) and the serving engine's
+    slot pool (:mod:`mmlspark_tpu.serve.cache_pool`), which preallocates
+    the same shapes once per process.
+
+    Raises :class:`FriendlyError` (never a bare KeyError — the decode-API
+    fuzz contract) when ``graph.extra`` lacks the ``heads`` metadata or a
+    cache-accepting block's variables lack the ``attn/qkv`` param path
+    the geometry is read from."""
+    heads = graph.extra.get("heads")
+    if not heads:
+        raise FriendlyError(
+            f"KV-cache decode needs graph.extra['heads'] to size the "
+            f"cache buffers; '{graph.name}' does not record it — register "
+            "the model builder with heads metadata in extra"
+        )
+    hk = graph.extra.get("kv_heads") or heads
+    geometry = {}
     for name, mod in graph.blocks:
         if not _accepts_kwarg(mod, "cache"):
             continue
-        kern = variables[name]["params"]["attn"]["qkv"]["kernel"]
-        d = kern.shape[1] // (h + 2 * hk)
+        try:
+            kern = variables[name]["params"]["attn"]["qkv"]["kernel"]
+        except (KeyError, TypeError) as e:
+            raise FriendlyError(
+                f"block '{name}' of '{graph.name}' accepts a cache kwarg "
+                "but its variables lack the fused qkv kernel the cache "
+                "geometry is read from (params/attn/qkv/kernel); cached "
+                "decode requires the transformer attention layout"
+            ) from e
+        geometry[name] = (hk, kern.shape[1] // (heads + 2 * hk))
+    return geometry
+
+
+def init_cache(graph, variables, batch: int, total: int) -> dict:
+    """Preallocated per-block K/V decode buffers, ``(B, total, hk, d)``
+    bf16 zeros for every block that takes a ``cache`` kwarg (geometry
+    from :func:`cache_geometry`)."""
+    cache = {}
+    for name, (hk, d) in cache_geometry(graph, variables).items():
         buf = jnp.zeros((batch, total, hk, d), jnp.bfloat16)
         cache[name] = (buf, buf)
     return cache
@@ -314,8 +344,19 @@ def beam_search(graph, variables, prompt, max_new_tokens: int, *,
     scan). Finished beams (``eos_id``) emit ``pad_id`` at frozen score.
 
     ``length_penalty`` alpha divides final scores by ``gen_len**alpha``
-    (0 = plain sum of log-probs). Returns the best (B, P+N) buffer, or
-    with ``return_all`` a tuple of ((B, K, P+N) sequences sorted by the
+    (0 = plain sum of log-probs). Length-penalty simplification (ADVICE
+    round 5): a finished beam's score and ``gen_len`` FREEZE at the step
+    its eos was emitted, but the beam keeps competing in the per-step
+    top-k against still-growing candidates instead of moving to a
+    separate finished-hypotheses pool as in the conventional
+    compare-at-finish formulation — so with ``alpha > 0`` short finished
+    beams are mildly favored over what standard length-normalized beam
+    search would rank. The final adjusted score of a finished beam is
+    its frozen score divided by its final ``gen_len**alpha``. Exact
+    parity with the standard formulation would require early-termination
+    bookkeeping of finished hypotheses, which this static-shape scan
+    deliberately omits. Returns the best (B, P+N) buffer, or with
+    ``return_all`` a tuple of ((B, K, P+N) sequences sorted by the
     search, (B, K) adjusted scores).
 
     Works with every cached-decode configuration: GQA, RoPE, sliding

@@ -57,9 +57,10 @@ class TokenPosEmbed(nn.Module):
 
     @nn.compact
     def __call__(self, ids, pos=None):
-        # ids: (B, T) int; ``pos`` (traced scalar) offsets the position
-        # table for cached decode, where T is the step width not the
-        # absolute position
+        # ids: (B, T) int; ``pos`` (traced scalar, or a (B,) vector of
+        # PER-ROW offsets for the serving engine's multi-tenant decode)
+        # offsets the position table for cached decode, where T is the
+        # step width not the absolute position
         tok = nn.Embed(self.vocab_size, self.d_model,
                        param_dtype=jnp.float32, name="token")(ids)
         if not self.learned_pos:
@@ -70,6 +71,9 @@ class TokenPosEmbed(nn.Module):
         )
         if pos is None:
             return tok + table[None, : ids.shape[1]]
+        if jnp.ndim(pos):  # per-row offsets: gather (B, T) table rows
+            positions = jnp.asarray(pos)[:, None] + jnp.arange(ids.shape[1])
+            return tok + jnp.take(table, positions, axis=0)
         rows = jax.lax.dynamic_slice(
             table, (pos, 0), (ids.shape[1], self.d_model)
         )
@@ -104,7 +108,12 @@ class SelfAttention(nn.Module):
         if self.rope:
             from mmlspark_tpu.ops.rope import apply_rope
 
-            positions = None if cache is None else pos + jnp.arange(t)
+            if cache is None:
+                positions = None
+            elif jnp.ndim(pos):  # per-row serve decode: (B, T) positions
+                positions = jnp.asarray(pos)[:, None] + jnp.arange(t)
+            else:
+                positions = pos + jnp.arange(t)
             q = apply_rope(q, positions)
             k = apply_rope(k, positions)
         if self.attn_impl not in ATTN_IMPLS:
@@ -130,19 +139,34 @@ class SelfAttention(nn.Module):
                     "rolled cache decode is single-token (t=1); "
                     "prefill uses the linear cache path"
                 )
+            per_row = bool(jnp.ndim(pos))
+            if per_row and (rolled or t != 1):
+                raise ParamError(
+                    "per-row cache positions (the serve engine's fused "
+                    "decode step) are single-token and linear-cache only"
+                )
             ck, cv = cache
-            # rolled (O(window) circular, sliding-window models on long
-            # generations): this step's K/V land at slot pos % W —
-            # every written slot is inside the window by construction
-            # (ops/attention.py rolled_window_attention). Linear: the
-            # write index IS the absolute position.
-            idx = pos % ck.shape[1] if rolled else pos
-            ck = jax.lax.dynamic_update_slice(
-                ck, k.astype(ck.dtype), (0, idx, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v.astype(cv.dtype), (0, idx, 0, 0)
-            )
+            if per_row:
+                # multi-tenant decode (mmlspark_tpu.serve): every batch
+                # row is a different request writing its own absolute
+                # position in its own slot buffer
+                rows = jnp.arange(b)
+                ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
+                cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+            else:
+                # rolled (O(window) circular, sliding-window models on
+                # long generations): this step's K/V land at slot
+                # pos % W — every written slot is inside the window by
+                # construction (ops/attention.py
+                # rolled_window_attention). Linear: the write index IS
+                # the absolute position.
+                idx = pos % ck.shape[1] if rolled else pos
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, idx, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, idx, 0, 0)
+                )
             new_cache = (ck, cv)
             if rolled:
                 from mmlspark_tpu.ops.attention import (

@@ -29,9 +29,26 @@ def causal_block_mask(q_len: int, kv_len: int, q_offset, kv_offset,
 
     Offsets may be traced scalars (ring steps compute the kv offset from
     the rotating source index) — only the lengths must be static.
+    ``q_offset`` may also be a PER-ROW vector (B,) — the serving engine's
+    fused decode step, where every batch row is a different request at
+    its own absolute position — producing a (B, 1, q_len, kv_len) mask
+    that broadcasts over heads; ``kv_offset`` must be scalar then (slot
+    caches all start at position 0).
     """
-    qi = q_offset + jnp.arange(q_len)[:, None]
-    kj = kv_offset + jnp.arange(kv_len)[None, :]
+    q_offset = jnp.asarray(q_offset)
+    if q_offset.ndim:
+        if jnp.ndim(kv_offset):
+            raise ValueError(
+                "per-row q_offset requires a scalar kv_offset"
+            )
+        qi = (
+            q_offset[:, None, None, None]
+            + jnp.arange(q_len)[None, None, :, None]
+        )  # (B, 1, Q, 1)
+        kj = kv_offset + jnp.arange(kv_len)[None, None, None, :]
+    else:
+        qi = q_offset + jnp.arange(q_len)[:, None]
+        kj = kv_offset + jnp.arange(kv_len)[None, :]
     dead = kj > qi
     if window is not None:
         dead = dead | (kj <= qi - window)
@@ -135,6 +152,8 @@ def dense_attention(q, k, v, *, causal: bool = False,
     decomposes the same math across devices and must match this output.
     ``window`` is the causal sliding window (same semantics as the flash
     kernel: each query sees its W most recent keys; requires causal).
+    ``q_offset`` may be a (B,) vector of per-row positions (the serving
+    engine's multi-tenant decode step — see ``causal_block_mask``).
     """
     if window is not None:
         if not causal:

@@ -20,32 +20,41 @@ import jax.numpy as jnp
 
 
 def rope_tables(positions, head_dim: int, base: float = 10000.0):
-    """cos/sin tables, each (len(positions), head_dim // 2) float32.
+    """cos/sin tables, each ``positions.shape + (head_dim // 2,)``
+    float32.
 
     ``positions`` is any integer/float vector — contiguous iota for the
-    common case, but arbitrary (e.g. cache offsets) values work.
+    common case, but arbitrary (e.g. cache offsets) values work — or a
+    (B, S) matrix of PER-ROW positions (the serving engine's fused
+    decode step, where each batch row is at its own absolute offset).
     """
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
     half = head_dim // 2
     inv_freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(ang), jnp.sin(ang)
 
 
 def apply_rope(x, positions=None, *, base: float = 10000.0):
     """Rotate ``x`` of shape (B, S, H, D) by position; D must be even.
 
-    ``positions`` defaults to 0..S-1. The rotation is applied in f32 and
+    ``positions`` defaults to 0..S-1; a (B, S) matrix applies per-row
+    positions (multi-tenant decode). The rotation is applied in f32 and
     cast back to ``x.dtype`` (bf16 activations keep their dtype through
     the attention stack).
     """
     b, s, h, d = x.shape
     if positions is None:
         positions = jnp.arange(s)
-    cos, sin = rope_tables(positions, d, base)  # (S, D/2)
-    cos = cos[None, :, None, :]  # broadcast over (B, H)
-    sin = sin[None, :, None, :]
+    positions = jnp.asarray(positions)
+    cos, sin = rope_tables(positions, d, base)  # positions.shape + (D/2,)
+    if positions.ndim == 2:  # (B, S, D/2): broadcast over H only
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:  # (S, D/2): broadcast over (B, H)
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     return jnp.concatenate(
         (x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1

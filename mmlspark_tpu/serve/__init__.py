@@ -1,0 +1,20 @@
+"""Continuous-batching serving engine over a slot-based KV-cache pool.
+
+The subsystem that turns ``models/generate.py``'s per-call static-shape
+decode into a multi-tenant engine (docs/SERVING.md): a preallocated
+``(slots, cache_len, hk, d)`` K/V pool (:mod:`cache_pool`), a
+tick-based continuous-batching scheduler (:mod:`scheduler`), the public
+``ServeEngine.submit/step/run`` API with admission control and
+per-request deadlines (:mod:`engine`), serving observability as
+``MetricData`` records (:mod:`metrics`), and a synthetic-traffic demo
+(:mod:`demo`, the ``python -m mmlspark_tpu serve`` body).
+"""
+
+from mmlspark_tpu.serve.cache_pool import SlotCachePool  # noqa: F401
+from mmlspark_tpu.serve.engine import ServeEngine  # noqa: F401
+from mmlspark_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from mmlspark_tpu.serve.scheduler import (  # noqa: F401
+    ContinuousBatchScheduler,
+    RequestResult,
+    ServeRequest,
+)

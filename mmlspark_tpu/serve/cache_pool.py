@@ -1,0 +1,121 @@
+"""Slot-based KV-cache pool for the continuous-batching serving engine.
+
+``models/generate.py`` preallocates one ``(B, total, hk, d)`` K/V buffer
+pair per block PER CALL — correct for offline batch decode, wasteful for
+serving, where requests arrive and retire continuously. The pool flips
+the allocation: ONE ``(S, cache_len, hk, d)`` buffer pair per block for
+the whole process (head geometry from
+:func:`mmlspark_tpu.models.generate.cache_geometry`, the same fused-qkv
+readout ``init_cache`` uses), where ``S`` is the number of serving slots.
+A request leases a slot for its lifetime, the prefill writes its
+prompt's K/V into positions ``[0, P)`` of that slot row, decode steps
+append one position per tick, and retirement frees the slot for the next
+request — no allocation, no reshape, no recompile anywhere in steady
+state, which is what lets the scheduler's fused decode step stay a
+single XLA program (the TensorFlow-style decoupled-worker dataflow,
+arXiv:1605.08695, with fixed-shape device steps).
+
+Stale K/V from a previous lease is harmless by construction: a new lease
+always prefills ``[0, P)`` with ``P >= 1``, and the causal mask
+(``q_offset = pos``) hides every position beyond the current request's
+own write frontier.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.models.generate import cache_geometry
+
+
+class SlotCachePool:
+    """Preallocated per-block K/V buffers with slot lease/free accounting.
+
+    ``buffers`` is the live pytree the scheduler's jitted decode step
+    reads and returns — ``{block: (K, V)}`` with each array
+    ``(slots, cache_len, hk, d)`` bf16. The pool owns the host-side
+    bookkeeping (which slots are leased); the arrays themselves stay on
+    device and are replaced functionally each tick.
+    """
+
+    def __init__(self, graph, variables, slots: int, cache_len: int):
+        if slots < 1:
+            raise FriendlyError(f"slots must be >= 1, got {slots}")
+        if cache_len < 2:
+            raise FriendlyError(
+                f"cache_len must be >= 2 (one prompt token + one "
+                f"generated), got {cache_len}"
+            )
+        geometry = cache_geometry(graph, variables)
+        if not geometry:
+            raise FriendlyError(
+                f"'{graph.name}' has no cache-accepting blocks; the "
+                "serving engine needs the KV-cache decode path "
+                "(transformer_lm family)"
+            )
+        self.num_slots = slots
+        self.cache_len = cache_len
+        self.buffers = {}
+        for name, (hk, d) in geometry.items():
+            buf = jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16)
+            self.buffers[name] = (buf, buf)
+        # LIFO free list popping the lowest id first keeps slot
+        # assignment deterministic for the parity tests
+        self._free = list(range(slots - 1, -1, -1))
+        self._leased: set[int] = set()
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def leased_count(self) -> int:
+        return len(self._leased)
+
+    @property
+    def utilization(self) -> float:
+        return len(self._leased) / self.num_slots
+
+    def lease(self) -> int:
+        if not self._free:
+            raise FriendlyError(
+                f"no free KV-cache slots (all {self.num_slots} leased); "
+                "the scheduler should admit only into free slots — free "
+                "a retired slot first or build the pool with more slots"
+            )
+        slot = self._free.pop()
+        self._leased.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._leased:
+            raise FriendlyError(
+                f"slot {slot} is not leased (double free, or never "
+                f"leased from this pool of {self.num_slots})"
+            )
+        self._leased.remove(slot)
+        self._free.append(slot)
+
+    # -- data path ---------------------------------------------------------
+
+    def write_prefill(self, slot: int, prefill_cache: dict,
+                      length: int) -> None:
+        """Copy a batch-1 prefill cache (buffers of exactly ``length``
+        positions, from ``init_cache(graph, variables, 1, P)``) into
+        positions ``[0, length)`` of the slot's row."""
+        if slot not in self._leased:
+            raise FriendlyError(f"slot {slot} is not leased")
+        if length > self.cache_len:
+            raise FriendlyError(
+                f"prefill length {length} exceeds the pool's cache_len "
+                f"{self.cache_len}"
+            )
+        for name, (pk, pv) in self.buffers.items():
+            ck, cv = prefill_cache[name]
+            self.buffers[name] = (
+                pk.at[slot, :length].set(ck[0, :length].astype(pk.dtype)),
+                pv.at[slot, :length].set(cv[0, :length].astype(pv.dtype)),
+            )

@@ -1,0 +1,69 @@
+"""Synthetic-traffic serving demo — the ``serve`` subcommand's body and
+``bench.py``'s ``serve`` metric group.
+
+Drives a ``ServeEngine`` over a small random-init ``transformer_lm``
+with a deterministic staggered arrival schedule (a few submits per tick,
+prompt lengths drawn from a seeded rng), mirroring ``bench``'s contract:
+ONE parseable JSON line out, carrying queue-depth, TTFT, per-token
+latency, slot-utilization, and throughput metrics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_demo(*, slots: int = 4, n_requests: int = 8,
+             max_new_tokens: int = 8, arrivals_per_tick: int = 2,
+             vocab: int = 64, d_model: int = 32, heads: int = 2,
+             depth: int = 2, cache_len: int = 64, seed: int = 0,
+             deadline_ticks: int | None = None) -> dict:
+    """Run the synthetic-traffic loop; returns the metrics dict the CLI
+    prints as its one JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve.engine import ServeEngine
+
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len, attn_impl="dense",
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )
+    engine = ServeEngine(
+        graph, variables, slots=slots, cache_len=cache_len,
+        max_queue=max(n_requests, 1),
+    )
+
+    rng = np.random.default_rng(seed)
+    lo, hi = 4, max(5, min(16, cache_len - max_new_tokens))
+    lengths = rng.integers(lo, hi + 1, size=n_requests)
+    prompts = [rng.integers(0, vocab, size=int(p)) for p in lengths]
+
+    submitted = 0
+    results = {}
+    while submitted < n_requests or engine.busy:
+        for _ in range(arrivals_per_tick):
+            if submitted < n_requests:
+                engine.submit(
+                    prompts[submitted], max_new_tokens,
+                    deadline_ticks=deadline_ticks,
+                )
+                submitted += 1
+        for res in engine.step():
+            results[res.id] = res
+
+    out = engine.metrics.to_dict()
+    out.update(
+        n_requests=n_requests,
+        arrivals_per_tick=arrivals_per_tick,
+        max_new_tokens=max_new_tokens,
+        cache_len=cache_len,
+        decode_compiles=engine.decode_compile_count,
+        model_config={"vocab": vocab, "d_model": d_model, "heads": heads,
+                      "depth": depth},
+    )
+    return out

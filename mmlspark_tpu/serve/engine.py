@@ -1,0 +1,262 @@
+"""``ServeEngine`` — the public continuous-batching serving API.
+
+Turns the repo's static-shape KV-cache decode (``models/generate.py``)
+into a multi-tenant engine: requests of different prompt lengths and
+arrival times share ONE jitted decode step over the slot pool's
+fixed-shape buffers, so XLA compiles the decode program exactly once per
+engine (asserted by ``tests/test_serve.py`` via
+``decode_compile_count``). Prefill is its own jitted program, retraced
+per distinct prompt length — the classic serving trade: joiners pay a
+length-bucketed prefill, the steady-state decode tick never recompiles.
+
+Usage::
+
+    engine = ServeEngine(graph, variables, slots=8)
+    rid = engine.submit(prompt_ids, max_new_tokens=32)   # queued
+    results = engine.run()                                # drain
+    results[rid].tokens                                   # prompt + gen
+
+``submit`` is admission-controlled (bounded queue raises the typed
+:class:`FriendlyError` when full) and validates per-request budgets
+against the pool's ``cache_len``; ``step()`` runs one scheduler tick
+(admit -> fused decode -> retire) and returns the requests that finished
+on it; ``run()`` loops ``step()`` until idle. Decode is greedy
+(temperature-0) — identical tokens to ``generate()`` per request, which
+is the engine's correctness contract.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.models.generate import _cached_apply, init_cache
+from mmlspark_tpu.serve.cache_pool import SlotCachePool
+from mmlspark_tpu.serve.metrics import ServeMetrics
+from mmlspark_tpu.serve.scheduler import (
+    ContinuousBatchScheduler,
+    RequestResult,
+    ServeRequest,
+)
+from mmlspark_tpu.utils.profiling import annotate
+
+
+class ServeEngine:
+    def __init__(self, graph, variables, *, slots: int = 4,
+                 cache_len: int | None = None, max_queue: int = 16,
+                 pad_id: int = 0):
+        if not graph.extra.get("causal", False):
+            raise FriendlyError(
+                f"serving needs a causal LM; '{graph.name}' has "
+                "causal=False"
+            )
+        max_len = graph.input_shape[0] if graph.input_shape else None
+        if cache_len is None:
+            if not max_len:
+                raise FriendlyError(
+                    f"'{graph.name}' records no input_shape; pass "
+                    "cache_len explicitly to size the slot KV buffers"
+                )
+            cache_len = max_len
+        if (
+            max_len
+            and cache_len > max_len
+            and graph.extra.get("pos_embedding", "learned") == "learned"
+        ):
+            raise FriendlyError(
+                f"cache_len ({cache_len}) exceeds the learned position "
+                f"table ({max_len}); build the model with a larger "
+                "max_len or pos_embedding='rope'"
+            )
+        window = graph.extra.get("window")
+        if window and window < cache_len:
+            raise FriendlyError(
+                f"'{graph.name}' uses a sliding window ({window}) "
+                f"smaller than cache_len ({cache_len}); the slot pool "
+                "holds linear per-slot buffers only — rolled circular "
+                "buffers are not pooled yet. Serve with cache_len <= "
+                "window, or build the model without window"
+            )
+        self.graph = graph
+        self.variables = variables
+        self.pad_id = pad_id
+        self.cache_len = cache_len
+        self.pool = SlotCachePool(graph, variables, slots, cache_len)
+        self.metrics = ServeMetrics(graph.name, slots)
+        self._sched = ContinuousBatchScheduler(self.pool,
+                                               max_queue=max_queue)
+        self._next_id = 0
+
+        def _prefill(variables, prompt):
+            # (1, P) -> first greedy token + a length-P linear cache;
+            # jit retraces per distinct P (length-bucketed prefill)
+            cache = init_cache(graph, variables, 1, prompt.shape[1])
+            logits, cache = _cached_apply(graph, variables, prompt,
+                                          cache, 0)
+            first = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+            return first.astype(jnp.int32), cache
+
+        def _decode(variables, buffers, tok, pos):
+            # ONE fused single-token step for every slot: tok/pos are
+            # (S,) and every slot decodes at its own absolute position
+            # (per-row q_offset through ops/attention.py). Fixed shapes
+            # -> compiled exactly once.
+            logits, buffers = _cached_apply(
+                graph, variables, tok[:, None], buffers, pos, step=True
+            )
+            nxt = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1)
+            return nxt.astype(jnp.int32), buffers
+
+        self._prefill = jax.jit(_prefill)
+        self._decode = jax.jit(_decode)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def tick(self) -> int:
+        return self._sched.tick_count
+
+    @property
+    def queue_depth(self) -> int:
+        return self._sched.queue_depth
+
+    @property
+    def busy(self) -> bool:
+        return self._sched.busy
+
+    @property
+    def decode_compile_count(self) -> int:
+        """How many programs the fused decode step has compiled — the
+        continuous-batching invariant says this stays 1 for the life of
+        the engine (asserted in tests)."""
+        cache_size = getattr(self._decode, "_cache_size", None)
+        return cache_size() if callable(cache_size) else -1
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               eos_id: int | None = None,
+               deadline_ticks: int | None = None) -> int:
+        """Queue one request; returns its id. Raises
+        :class:`FriendlyError` on invalid budgets or a full queue
+        (admission control) — never a bare KeyError/ValueError.
+
+        ``deadline_ticks``: the request must FINISH within that many
+        scheduler ticks of submission or it expires (queued or
+        mid-decode), surfacing as status ``"expired"``.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise FriendlyError(
+                f"prompt must be a non-empty 1-D token vector, got "
+                f"shape {prompt.shape} (the engine serves one request "
+                "per submit; batch by submitting several)"
+            )
+        if max_new_tokens < 1:
+            raise FriendlyError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        total = int(prompt.size) + max_new_tokens
+        if total > self.cache_len:
+            raise FriendlyError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine's cache_len "
+                f"({self.cache_len}); shorten the request or build the "
+                "engine with a larger cache_len"
+            )
+        if deadline_ticks is not None and deadline_ticks < 1:
+            raise FriendlyError(
+                f"deadline_ticks must be >= 1, got {deadline_ticks}"
+            )
+        req = ServeRequest(
+            id=self._next_id,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_id=eos_id,
+            deadline_tick=(
+                self.tick + deadline_ticks
+                if deadline_ticks is not None else None
+            ),
+            submit_tick=self.tick,
+            submit_wall=time.perf_counter(),
+        )
+        try:
+            self._sched.enqueue(req)
+        except FriendlyError:
+            self.metrics.record_reject()
+            raise
+        self._next_id += 1
+        self.metrics.record_submit()
+        return req.id
+
+    def step(self) -> list[RequestResult]:
+        """One scheduler tick: expire deadlines, admit queued requests
+        into free slots (prefill per joiner), one fused decode step for
+        all active slots, retire finished sequences. Returns the
+        requests that reached a terminal state this tick."""
+        t0 = time.perf_counter()
+        tick = self._sched.tick_count
+        finished = self._sched.expire(tick)
+
+        with annotate("serve.admit"):
+            while self._sched.queue_depth and self.pool.free_count:
+                req = self._sched.pop_next()
+                slot = self.pool.lease()
+                with annotate("serve.prefill"):
+                    first, cache = self._prefill(
+                        self.variables, jnp.asarray(req.prompt[None])
+                    )
+                    self.pool.write_prefill(slot, cache, len(req.prompt))
+                    first = int(first[0])
+                self.metrics.record_first_token(req, tick)
+                done = self._sched.activate(slot, req, first, tick)
+                if done is not None:
+                    finished.append(done)
+
+        if self._sched.active:
+            n_active = len(self._sched.active)
+            tok, pos = self._sched.decode_inputs(self.pad_id)
+            with annotate("serve.decode"):
+                td = time.perf_counter()
+                nxt, buffers = self._decode(
+                    self.variables, self.pool.buffers,
+                    jnp.asarray(tok), jnp.asarray(pos),
+                )
+                self.pool.buffers = buffers
+                nxt = np.asarray(nxt)  # host sync: (S,) int32 only
+                self.metrics.record_decode(
+                    n_active, time.perf_counter() - td
+                )
+            finished.extend(self._sched.consume(nxt, tick))
+
+        self._sched.tick_count += 1
+        self.metrics.sample_tick(
+            self._sched.queue_depth, self.pool.leased_count,
+            time.perf_counter() - t0,
+        )
+        for res in finished:
+            self.metrics.record_finish(res)
+        return finished
+
+    def run(self, max_ticks: int = 100_000) -> dict[int, RequestResult]:
+        """Step until queue and slots drain; results keyed by request
+        id. ``max_ticks`` bounds runaway loops (a generator that never
+        emits EOS still retires at its token budget, so hitting the
+        bound means a caller bug — reported as the typed error)."""
+        results: dict[int, RequestResult] = {}
+        start = self.tick
+        while self._sched.busy:
+            if self.tick - start >= max_ticks:
+                raise FriendlyError(
+                    f"serve run() exceeded max_ticks ({max_ticks}) with "
+                    f"{self._sched.queue_depth} queued and "
+                    f"{len(self._sched.active)} active requests"
+                )
+            for res in self.step():
+                results[res.id] = res
+        return results

@@ -1,0 +1,147 @@
+"""Serving observability: queue depth, time-to-first-token, per-token
+latency, slot utilization, throughput.
+
+Surfaced two ways, matching the framework's metric UX
+(:mod:`mmlspark_tpu.core.metrics_contracts`): ``snapshot()`` returns
+structured :class:`MetricData` records (group ``"serve"``) for logging,
+and ``to_dict()`` returns the flat JSON-able dict the ``serve``
+subcommand and ``bench.py``'s ``serve`` metric group emit as one line.
+
+Tick-count figures (TTFT in ticks, queue depth) are DETERMINISTIC given
+the arrival schedule — the unit tests assert on them; wall-clock figures
+(TTFT ms, per-token ms, tokens/sec) describe the host+device reality and
+are what the bench records.
+"""
+
+from __future__ import annotations
+
+import time
+
+from mmlspark_tpu.core.metrics_contracts import MetricData
+
+
+def _mean(xs) -> float | None:
+    xs = list(xs)
+    return (sum(xs) / len(xs)) if xs else None
+
+
+class ServeMetrics:
+    def __init__(self, model: str, slots: int):
+        self.model = model
+        self.slots = slots
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.expired = 0
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.queue_depth_samples: list[int] = []
+        self.util_samples: list[float] = []
+        self.tick_seconds: list[float] = []
+        self.ttft_ticks: list[int] = []
+        self.ttft_s: list[float] = []
+        self.decode_seconds = 0.0
+        self.decode_tokens = 0
+        self._t0: float | None = None
+        self._t_last: float | None = None
+
+    # -- recording hooks (called by the engine) ---------------------------
+
+    def _touch(self) -> None:
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0 = now
+        self._t_last = now
+
+    def record_submit(self) -> None:
+        self.submitted += 1
+        self._touch()
+
+    def record_reject(self) -> None:
+        self.rejected += 1
+
+    def record_first_token(self, req, tick: int) -> None:
+        self.prefills += 1
+        self.ttft_ticks.append(tick - req.submit_tick)
+        self.ttft_s.append(time.perf_counter() - req.submit_wall)
+
+    def record_decode(self, n_active: int, seconds: float) -> None:
+        self.decode_seconds += seconds
+        self.decode_tokens += n_active
+
+    def record_finish(self, result) -> None:
+        if result.status == "expired":
+            self.expired += 1
+        else:
+            self.completed += 1
+        self.tokens_generated += result.generated
+        self._touch()
+
+    def sample_tick(self, queue_depth: int, leased: int,
+                    seconds: float) -> None:
+        self.queue_depth_samples.append(queue_depth)
+        self.util_samples.append(leased / self.slots)
+        self.tick_seconds.append(seconds)
+        self._touch()
+
+    # -- views -------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        wall = (
+            (self._t_last - self._t0)
+            if self._t0 is not None and self._t_last is not None
+            else 0.0
+        )
+        per_tok = (
+            self.decode_seconds / self.decode_tokens
+            if self.decode_tokens
+            else None
+        )
+        return {
+            "model": self.model,
+            "slots": self.slots,
+            "ticks": len(self.tick_seconds),
+            "submitted": self.submitted,
+            "rejected": self.rejected,
+            "completed": self.completed,
+            "expired": self.expired,
+            "tokens_generated": self.tokens_generated,
+            "queue_depth_mean": _mean(self.queue_depth_samples),
+            "queue_depth_max": (
+                max(self.queue_depth_samples)
+                if self.queue_depth_samples else None
+            ),
+            "ttft_ticks_mean": _mean(self.ttft_ticks),
+            "ttft_ms_mean": (
+                round(_mean(self.ttft_s) * 1e3, 3) if self.ttft_s else None
+            ),
+            "per_token_ms": (
+                round(per_tok * 1e3, 4) if per_tok is not None else None
+            ),
+            "slot_utilization_mean": (
+                round(_mean(self.util_samples), 4)
+                if self.util_samples else None
+            ),
+            "slot_utilization_peak": (
+                round(max(self.util_samples), 4)
+                if self.util_samples else None
+            ),
+            "tokens_per_sec": (
+                round(self.tokens_generated / wall, 1) if wall > 0 else None
+            ),
+            "wall_s": round(wall, 4),
+        }
+
+    def snapshot(self) -> list[MetricData]:
+        """Structured records for the logging/metrics plane; one
+        MetricData per scalar, group ``"serve"``."""
+        out = []
+        for name, value in self.to_dict().items():
+            if isinstance(value, (int, float)) and not isinstance(
+                value, bool
+            ):
+                out.append(MetricData(
+                    name=f"serve.{name}", value=float(value),
+                    model=self.model, group="serve",
+                ))
+        return out

@@ -1,0 +1,211 @@
+"""Continuous-batching scheduler: queue, slot states, and tick
+bookkeeping for the serving engine.
+
+The loop shape (one TICK = admit joiners -> one fused decode step for
+every active slot -> retire finished sequences) is the in-process analog
+of TensorFlow's decoupled dataflow workers (arXiv:1605.08695): requests
+of different lengths and arrival times share ONE compiled device step,
+because every tick presents the device with the same static shapes —
+``(S,)`` tokens, ``(S,)`` positions, the pool's ``(S, L, hk, d)``
+buffers. A sequence hitting EOS or its token budget frees its slot
+without stalling the rest of the batch; the next queued request takes
+the slot on the following tick.
+
+This module is pure host-side bookkeeping (no jax): the engine owns the
+jitted prefill/decode programs and the metrics, the scheduler owns who
+is where — FIFO queue, per-slot decode state, deadline expiry.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+
+
+@dataclass(frozen=True)
+class ServeRequest:
+    """One admitted-or-queued generation request (engine-internal; users
+    go through ``ServeEngine.submit`` which validates and ids it)."""
+
+    id: int
+    prompt: np.ndarray  # (P,) int32
+    max_new_tokens: int
+    eos_id: int | None
+    #: absolute tick by which the request must FINISH, else it expires
+    #: (queued or mid-decode); None = no deadline
+    deadline_tick: int | None
+    submit_tick: int
+    submit_wall: float
+
+
+@dataclass
+class RequestResult:
+    """Terminal record for one request: ``status`` is ``"completed"``
+    (budget or EOS reached) or ``"expired"`` (deadline passed while
+    queued or mid-decode — ``tokens`` then carries whatever was
+    generated). ``tokens`` includes the prompt, like ``generate()``."""
+
+    id: int
+    status: str
+    tokens: np.ndarray
+    prompt_len: int
+    generated: int
+    submit_tick: int
+    first_token_tick: int | None
+    finish_tick: int
+    wall_s: float
+
+
+@dataclass
+class _SlotState:
+    """Decode-side state of one active slot."""
+
+    req: ServeRequest
+    pos: int  # absolute position the NEXT decode step writes
+    last_token: int
+    out: list = field(default_factory=list)
+    first_token_tick: int = 0
+
+
+class ContinuousBatchScheduler:
+    def __init__(self, pool, max_queue: int):
+        if max_queue < 1:
+            raise FriendlyError(f"max_queue must be >= 1, got {max_queue}")
+        self.pool = pool
+        self.max_queue = max_queue
+        self.queue: deque[ServeRequest] = deque()
+        self.active: dict[int, _SlotState] = {}  # slot -> state
+        self.tick_count = 0
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.queue or self.active)
+
+    def enqueue(self, req: ServeRequest) -> None:
+        """Admission control: the queue is BOUNDED — a full queue rejects
+        at submit time with the typed error instead of buffering
+        unboundedly (graceful backpressure for the caller to act on)."""
+        if len(self.queue) >= self.max_queue:
+            raise FriendlyError(
+                f"serve queue is full ({self.max_queue} requests "
+                "waiting); step() the engine to drain it, or build the "
+                "engine with a larger max_queue"
+            )
+        self.queue.append(req)
+
+    def pop_next(self) -> ServeRequest:
+        return self.queue.popleft()
+
+    # -- tick phases -------------------------------------------------------
+
+    def expire(self, tick: int) -> list[RequestResult]:
+        """Retire every request (queued or active) whose deadline has
+        passed. Active expiries free their slot — the whole point of
+        per-request deadlines in a shared-slot engine: a stuck tenant
+        cannot hold a slot past its budget."""
+        out: list[RequestResult] = []
+        kept: deque[ServeRequest] = deque()
+        for req in self.queue:
+            if req.deadline_tick is not None and tick >= req.deadline_tick:
+                out.append(self._result(
+                    req, "expired", tokens=req.prompt, generated=0,
+                    first_token_tick=None, tick=tick,
+                ))
+            else:
+                kept.append(req)
+        self.queue = kept
+        for slot, st in list(self.active.items()):
+            req = st.req
+            if req.deadline_tick is not None and tick >= req.deadline_tick:
+                del self.active[slot]
+                self.pool.free(slot)
+                out.append(self._finish(st, "expired", tick))
+        return out
+
+    def activate(self, slot: int, req: ServeRequest, first_token: int,
+                 tick: int) -> RequestResult | None:
+        """Install a prefilled request into its slot. Returns a terminal
+        result immediately when the FIRST token already finishes it
+        (max_new_tokens == 1, or the first token is EOS) — the slot is
+        freed without ever joining the decode batch."""
+        st = _SlotState(req=req, pos=len(req.prompt),
+                        last_token=first_token, out=[first_token],
+                        first_token_tick=tick)
+        if (
+            req.max_new_tokens == 1
+            or (req.eos_id is not None and first_token == req.eos_id)
+        ):
+            self.pool.free(slot)
+            return self._finish(st, "completed", tick)
+        self.active[slot] = st
+        return None
+
+    def decode_inputs(self, pad_id: int) -> tuple[np.ndarray, np.ndarray]:
+        """The fused step's ``(S,)`` token and position vectors. Free
+        slots carry (pad, 0) — they run through the fixed-shape compute
+        and their outputs (and position-0 garbage writes into their own
+        free buffers) are ignored; the next lease's prefill overwrites
+        position 0 before anything reads it."""
+        tok = np.full((self.pool.num_slots,), pad_id, np.int32)
+        pos = np.zeros((self.pool.num_slots,), np.int32)
+        for slot, st in self.active.items():
+            tok[slot] = st.last_token
+            pos[slot] = st.pos
+        return tok, pos
+
+    def consume(self, next_tokens: np.ndarray,
+                tick: int) -> list[RequestResult]:
+        """Fold one fused decode step's output back into per-slot state;
+        retire sequences that hit EOS or their token budget, freeing
+        their slots for the next tick's admissions."""
+        finished: list[RequestResult] = []
+        for slot, st in list(self.active.items()):
+            nxt = int(next_tokens[slot])
+            st.out.append(nxt)
+            st.pos += 1
+            st.last_token = nxt
+            req = st.req
+            done = len(st.out) >= req.max_new_tokens or (
+                req.eos_id is not None and nxt == req.eos_id
+            )
+            if done:
+                del self.active[slot]
+                self.pool.free(slot)
+                finished.append(self._finish(st, "completed", tick))
+        return finished
+
+    # -- result assembly ---------------------------------------------------
+
+    def _finish(self, st: _SlotState, status: str,
+                tick: int) -> RequestResult:
+        tokens = np.concatenate(
+            [st.req.prompt, np.asarray(st.out, np.int32)]
+        )
+        return self._result(
+            st.req, status, tokens=tokens, generated=len(st.out),
+            first_token_tick=st.first_token_tick, tick=tick,
+        )
+
+    @staticmethod
+    def _result(req: ServeRequest, status: str, *, tokens, generated: int,
+                first_token_tick: int | None, tick: int) -> RequestResult:
+        return RequestResult(
+            id=req.id,
+            status=status,
+            tokens=np.asarray(tokens, np.int32),
+            prompt_len=len(req.prompt),
+            generated=generated,
+            submit_tick=req.submit_tick,
+            first_token_tick=first_token_tick,
+            finish_tick=tick,
+            wall_s=time.perf_counter() - req.submit_wall,
+        )

@@ -288,3 +288,28 @@ def test_beam_guards_and_moe():
     mv = moe.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     out = beam_search(moe, mv, prompt, max_new_tokens=3, beams=2)
     assert out.shape == (1, 7)
+
+
+def test_init_cache_friendly_errors():
+    """cache_geometry raises the typed error — never a bare KeyError —
+    when a graph lacks heads metadata or a cache-accepting block's
+    variables lack the fused qkv kernel (the decode-API fuzz contract)."""
+    from mmlspark_tpu.models.generate import init_cache
+
+    m = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
+                    depth=1, max_len=8)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    init_cache(m, v, 1, 8)  # healthy baseline
+
+    del m.extra["heads"]  # build_model returns a fresh graph per call
+    with pytest.raises(FriendlyError, match="heads"):
+        init_cache(m, v, 1, 8)
+
+    m2 = build_model("transformer_lm", vocab_size=8, d_model=16, heads=2,
+                     depth=1, max_len=8)
+    v2 = dict(v)
+    block = next(name for name, _ in m2.blocks
+                 if "attn" in v2.get(name, {}).get("params", {}))
+    v2[block] = {"params": {}}  # strip the attn/qkv path
+    with pytest.raises(FriendlyError, match="qkv"):
+        init_cache(m2, v2, 1, 8)

@@ -1,0 +1,274 @@
+"""Continuous-batching serving engine (mmlspark_tpu.serve).
+
+The contract under test (docs/SERVING.md): a slot-based KV-cache pool
+with exact lease/free accounting, an engine whose staggered multi-tenant
+decode emits BYTE-IDENTICAL tokens to single-request ``generate()``
+while compiling the fused decode step exactly once, deterministic
+tick-based deadlines, and typed admission-control errors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.metrics_contracts import MetricData
+from mmlspark_tpu.models import build_model, generate
+from mmlspark_tpu.serve import ServeEngine, SlotCachePool
+
+PERIOD = 4
+
+
+def _train_lm(m, steps=30, seq=16):
+    from mmlspark_tpu.testing.datagen import overfit_periodic_lm
+
+    return overfit_periodic_lm(m, steps=steps, seq=seq, period=PERIOD)
+
+
+def _tiny(**kw):
+    cfg = dict(vocab_size=8, d_model=32, heads=2, depth=2, max_len=32)
+    cfg.update(kw)
+    return build_model("transformer_lm", **cfg)
+
+
+# -- slot pool -------------------------------------------------------------
+
+
+def test_slot_pool_lease_free_accounting():
+    m = _tiny()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    pool = SlotCachePool(m, v, slots=3, cache_len=16)
+    assert pool.free_count == 3 and pool.leased_count == 0
+    assert pool.utilization == 0.0
+
+    a, b, c = pool.lease(), pool.lease(), pool.lease()
+    assert sorted((a, b, c)) == [0, 1, 2]
+    assert pool.free_count == 0 and pool.utilization == 1.0
+    with pytest.raises(FriendlyError, match="no free KV-cache slots"):
+        pool.lease()
+
+    pool.free(b)
+    assert pool.free_count == 1 and pool.leased_count == 2
+    with pytest.raises(FriendlyError, match="not leased"):
+        pool.free(b)  # double free
+    assert pool.lease() == b  # the freed slot is reusable
+
+    # buffer geometry: one (K, V) pair per cache-accepting block, slot-major
+    for ck, cv in pool.buffers.values():
+        assert ck.shape[:2] == (3, 16) and ck.dtype == jnp.bfloat16
+        assert cv.shape == ck.shape
+
+
+def test_slot_pool_guards():
+    m = _tiny()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(FriendlyError, match="slots"):
+        SlotCachePool(m, v, slots=0, cache_len=16)
+    with pytest.raises(FriendlyError, match="cache_len"):
+        SlotCachePool(m, v, slots=2, cache_len=1)
+
+
+# -- token parity (the acceptance test) ------------------------------------
+
+
+@pytest.mark.parametrize("config", [
+    {},                                        # learned positions
+    {"pos_embedding": "rope", "kv_heads": 1},  # RoPE + MQA
+])
+def test_staggered_arrivals_match_generate(config):
+    """Three requests with different prompt lengths, submitted on
+    different ticks, sharing 2 slots: every request's token stream must
+    be byte-identical to a single-request ``generate()`` call, and the
+    fused decode step must have compiled exactly once — requests joining
+    and leaving mid-flight never retrace it."""
+    m = _tiny(**config)
+    v, ids = _train_lm(m)
+    prompts = [np.asarray(ids[0, :n]) for n in (4, 6, 7)]
+    want = {
+        i: np.asarray(generate(m, v, p[None], max_new_tokens=8))[0]
+        for i, p in enumerate(prompts)
+    }
+
+    engine = ServeEngine(m, v, slots=2, cache_len=32)
+    results = {}
+    rid_to_idx = {}
+    for i, p in enumerate(prompts):  # staggered: one submit per tick
+        rid_to_idx[engine.submit(p, max_new_tokens=8)] = i
+        for res in engine.step():
+            results[res.id] = res
+    while engine.busy:
+        for res in engine.step():
+            results[res.id] = res
+
+    assert len(results) == 3
+    for rid, res in results.items():
+        assert res.status == "completed"
+        np.testing.assert_array_equal(
+            np.asarray(res.tokens), want[rid_to_idx[rid]]
+        )
+    assert engine.decode_compile_count == 1
+
+
+def test_more_requests_than_slots_still_match():
+    """Queue pressure: 4 requests through 1 slot — pure sequential
+    reuse of the same slot buffers (stale K/V from the previous tenant
+    must be invisible)."""
+    m = _tiny()
+    v, ids = _train_lm(m)
+    prompts = [np.asarray(ids[0, :n]) for n in (4, 5, 6, 8)]
+    engine = ServeEngine(m, v, slots=1, cache_len=32, max_queue=4)
+    rids = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    results = engine.run()
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(generate(m, v, p[None], max_new_tokens=6))[0]
+        np.testing.assert_array_equal(np.asarray(results[rid].tokens), want)
+    assert engine.decode_compile_count == 1
+
+
+def test_eos_retires_early():
+    m = _tiny()
+    v, ids = _train_lm(m)
+    prompt = np.asarray(ids[0, :4])
+    ref = np.asarray(generate(m, v, prompt[None], max_new_tokens=8))[0]
+    eos = int(ref[5])  # the 2nd generated token, by construction
+    engine = ServeEngine(m, v, slots=2, cache_len=32)
+    rid = engine.submit(prompt, max_new_tokens=8, eos_id=eos)
+    res = engine.run()[rid]
+    assert res.status == "completed"
+    assert res.generated == 2 and int(res.tokens[-1]) == eos
+
+
+# -- deadlines and admission control ---------------------------------------
+
+
+def test_deadline_expiry_in_queue():
+    """With 1 slot busy on a long request, a queued request whose
+    deadline passes expires WITHOUT ever being admitted (no prefill, no
+    tokens) — deterministic in ticks."""
+    m = _tiny()
+    v, ids = _train_lm(m, steps=5)
+    engine = ServeEngine(m, v, slots=1, cache_len=32, max_queue=2)
+    rid_a = engine.submit(np.asarray(ids[0, :4]), max_new_tokens=10)
+    rid_b = engine.submit(np.asarray(ids[0, :5]), max_new_tokens=4,
+                          deadline_ticks=2)
+    results = engine.run()
+    assert results[rid_a].status == "completed"
+    assert results[rid_a].generated == 10
+    assert results[rid_b].status == "expired"
+    assert results[rid_b].generated == 0
+    assert engine.metrics.expired == 1 and engine.metrics.completed == 1
+
+
+def test_queue_full_raises_typed_error():
+    m = _tiny()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    engine = ServeEngine(m, v, slots=1, cache_len=32, max_queue=2)
+    engine.submit(np.ones(4, np.int32), max_new_tokens=2)
+    engine.submit(np.ones(4, np.int32), max_new_tokens=2)
+    with pytest.raises(FriendlyError, match="queue is full"):
+        engine.submit(np.ones(4, np.int32), max_new_tokens=2)
+    assert engine.metrics.rejected == 1
+    assert engine.metrics.submitted == 2
+
+
+def test_submit_validation():
+    m = _tiny()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    engine = ServeEngine(m, v, slots=1, cache_len=16)
+    with pytest.raises(FriendlyError, match="1-D"):
+        engine.submit(np.ones((2, 4), np.int32), max_new_tokens=2)
+    with pytest.raises(FriendlyError, match="max_new_tokens"):
+        engine.submit(np.ones(4, np.int32), max_new_tokens=0)
+    with pytest.raises(FriendlyError, match="cache_len"):
+        engine.submit(np.ones(10, np.int32), max_new_tokens=10)
+    with pytest.raises(FriendlyError, match="deadline_ticks"):
+        engine.submit(np.ones(4, np.int32), max_new_tokens=2,
+                      deadline_ticks=0)
+
+
+def test_engine_build_guards():
+    m = _tiny()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    # learned position table bounds cache_len
+    with pytest.raises(FriendlyError, match="position table"):
+        ServeEngine(m, v, cache_len=64)
+    # sliding-window models roll their cache; the linear slot pool
+    # refuses rather than silently mis-serving long requests
+    mw = _tiny(window=6)
+    vw = mw.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(FriendlyError, match="window"):
+        ServeEngine(mw, vw, cache_len=32)
+    ServeEngine(mw, vw, cache_len=6)  # cache_len <= window is fine
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_metrics_dict_and_snapshot():
+    m = _tiny()
+    v, ids = _train_lm(m, steps=5)
+    engine = ServeEngine(m, v, slots=2, cache_len=32)
+    engine.submit(np.asarray(ids[0, :4]), max_new_tokens=3)
+    engine.submit(np.asarray(ids[0, :6]), max_new_tokens=3)
+    engine.run()
+
+    d = engine.metrics.to_dict()
+    for key in ("queue_depth_mean", "queue_depth_max", "ttft_ticks_mean",
+                "ttft_ms_mean", "per_token_ms", "slot_utilization_mean",
+                "slot_utilization_peak", "tokens_per_sec"):
+        assert d[key] is not None, key
+    assert d["completed"] == 2 and d["tokens_generated"] == 6
+    assert 0.0 < d["slot_utilization_peak"] <= 1.0
+    json.dumps(d)  # the CLI's one-line contract: JSON-able as-is
+
+    records = engine.metrics.snapshot()
+    assert records and all(isinstance(r, MetricData) for r in records)
+    assert all(r.group == "serve" for r in records)
+    names = {r.name for r in records}
+    assert "serve.completed" in names and "serve.per_token_ms" in names
+
+
+# -- soak / CLI (slow tier) ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_demo_soak():
+    from mmlspark_tpu.serve.demo import run_demo
+
+    out = run_demo(slots=3, n_requests=10, max_new_tokens=6,
+                   arrivals_per_tick=2, cache_len=48, seed=1)
+    assert out["completed"] == 10 and out["expired"] == 0
+    assert out["decode_compiles"] == 1
+    assert out["tokens_generated"] == 60
+
+
+@pytest.mark.slow
+def test_cli_serve_demo_emits_one_json_line():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    res = subprocess.run(
+        [sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4", "serve",
+         "--demo", "--slots", "2", "--requests", "4",
+         "--max-new-tokens", "4"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd="/root/repo",
+    )
+    assert res.returncode == 0, res.stderr
+    lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1  # ONE parseable JSON line, mirroring bench
+    metrics = json.loads(lines[0])
+    for key in ("queue_depth_mean", "ttft_ms_mean", "per_token_ms",
+                "slot_utilization_mean", "tokens_per_sec"):
+        assert key in metrics, key
+    assert metrics["completed"] == 4
+    assert metrics["decode_compiles"] == 1

@@ -21,9 +21,13 @@ per call, not per token):
 Both paths run ``attn_impl='dense'`` so the comparison isolates the
 cache machinery, not flash-vs-dense kernel differences.
 
-Writes ``DECODE_TPU_EVIDENCE.json`` at the repo root for committing.
-A wedged tunnel is detected with a killable subprocess probe first, so
-the script fails fast with exit 2 instead of hanging.
+Writes ``DECODE_TPU_EVIDENCE.json`` at the repo root for committing —
+but ONLY when the run satisfies the committed-artifact contract that
+``tests/test_decode_evidence.py`` asserts (no ``noise_fallback`` on
+either path, monotone N=64 -> N=256 timings, speedup >= 1.5); a
+violating run prints its evidence and exits 3 without touching the
+artifact. A wedged tunnel is detected with a killable subprocess probe
+first, so the script fails fast with exit 2 instead of hanging.
 """
 
 from __future__ import annotations
@@ -184,6 +188,37 @@ def main() -> None:
     timing["kv_vs_recompute_speedup"] = round(speedup, 2)
     evidence["timing"] = timing
     print(f"kv-cache speedup vs recompute at N={N_LONG}: {speedup:.1f}x")
+
+    # -- contract gate -----------------------------------------------------
+    # tests/test_decode_evidence.py asserts these on the COMMITTED
+    # artifact, so an evidence file that would fail them must never be
+    # written: a run that violates the contract prints its evidence for
+    # debugging and exits 3, leaving any previously-committed good
+    # artifact in place.
+    violations = []
+    for name in ("kv_cache", "recompute"):
+        if timing[name]["noise_fallback"]:
+            violations.append(
+                f"{name}: t(N=256) - t(N=64) <= 0 (timing noise swallowed "
+                "the length delta; rerun on a quieter tunnel)"
+            )
+        if timing[name]["t_n256_s"] < timing[name]["t_n64_s"]:
+            violations.append(
+                f"{name}: t_n256 ({timing[name]['t_n256_s']}s) < t_n64 "
+                f"({timing[name]['t_n64_s']}s)"
+            )
+    if speedup < 1.5:
+        violations.append(
+            f"kv_vs_recompute_speedup {speedup:.2f} < 1.5 (the committed "
+            "contract floor; VERDICT r4 expects >= 5x)"
+        )
+    if violations:
+        print("evidence FAILED its own contract; NOT writing "
+              f"{os.path.basename(OUT)}:", file=sys.stderr)
+        for v in violations:
+            print(f"  - {v}", file=sys.stderr)
+        print(json.dumps(evidence, indent=1), file=sys.stderr)
+        sys.exit(3)
 
     with open(OUT, "w", encoding="utf-8") as f:
         json.dump(evidence, f, indent=1)
