@@ -718,9 +718,19 @@ def bench_serve(jax) -> dict:
     """Continuous-batching serving demo (mmlspark_tpu.serve): synthetic
     staggered traffic through the slot-pool engine, reporting TTFT,
     per-token decode latency, slot utilization, and throughput — the
-    serving-plane complement to the per-call ``decode`` group. The fused
-    decode step must compile exactly once (``decode_compiles``); more
-    than one means the continuous-batching invariant broke on-chip."""
+    serving-plane complement to the per-call ``decode`` group.
+
+    Compile-count invariants ride along: the fused decode step must
+    compile exactly once (``decode_compiles``) and bucketed prefill at
+    most once per length bucket (``prefill_compiles`` vs
+    ``prefill_bucket_count``) — more means the continuous-batching
+    invariants broke on-chip. The length-aware decode kernel's win is
+    quantified by ``decode_flop_utilization`` (live KV rows the
+    split-KV read touched / rows a dense-over-cache_len read would
+    have) plus the raw ``decode_live_kv_tokens`` /
+    ``decode_dense_kv_tokens`` counters, and ``prefill_buckets`` maps
+    each padded bucket length to how many prompts landed in it — all
+    persisted in this group's ``serve`` scratch key as-is."""
     from mmlspark_tpu.serve.demo import run_demo
 
     full = _full_scale(jax)

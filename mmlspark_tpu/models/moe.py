@@ -113,7 +113,7 @@ class MoEBlock(nn.Module):
                              window=self.window, kv_heads=self.kv_heads,
                              rope=self.rope, mesh=None, dtype=self.dtype,
                              name="attn")(y, cache=cache, pos=pos,
-                                          rolled=rolled)
+                                          rolled=rolled, decode=decode)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn

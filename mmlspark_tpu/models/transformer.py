@@ -92,7 +92,8 @@ class SelfAttention(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, cache=None, pos=None, rolled=False):
+    def __call__(self, x, cache=None, pos=None, rolled=False,
+                 decode=False):
         b, t, _ = x.shape
         h, d = self.heads, self.head_dim
         hk = self.kv_heads or h
@@ -124,14 +125,16 @@ class SelfAttention(nn.Module):
         new_cache = None
         if cache is not None:
             # KV-cache decode (models/generate.py): the preallocated
-            # (B, total, hk, d) buffers take this step's K/V at ``pos``
-            # and the single fused dense step attends q against the whole
-            # buffer — unwritten future positions fall to the causal mask
-            # (q_offset=pos), so one static-shape program serves both
-            # prefill (t = prompt len, pos = 0) and decode (t = 1). The
-            # impl dispatch above is a *training/scoring* choice; a
-            # one-query read of HBM-resident K/V is bandwidth-bound and
-            # gains nothing from the flash/ring decompositions.
+            # (B, total, hk, d) buffers take this step's K/V at ``pos``;
+            # unwritten future positions are invisible either way —
+            # causal mask (q_offset=pos) on the dense read, live-length
+            # mask in the decode kernel — so one static-shape program
+            # serves both prefill (t = prompt len, pos = 0) and decode
+            # (t = 1). The impl dispatch above is a *training/scoring*
+            # choice; decode reads are bandwidth-bound, which is exactly
+            # why single-token steps route to the length-aware split-KV
+            # kernel below: it skips the HBM traffic for dead cache
+            # blocks instead of reorganizing compute.
             if not self.causal:
                 raise ParamError("cache decode requires causal=True")
             if rolled and t != 1:
@@ -174,6 +177,20 @@ class SelfAttention(nn.Module):
                 )
 
                 o = rolled_window_attention(q, ck, cv, pos)
+            elif decode and t == 1 and (
+                self.window is None or self.window >= ck.shape[1]
+            ):
+                # single-token DECODE step over a linear cache: the
+                # length-aware split-KV kernel reads only each row's
+                # LIVE positions [0, pos+1) — per-row work O(pos), not
+                # O(cache_len) — instead of a dense read of the whole
+                # buffer. Window models reach here only when the window
+                # covers the buffer (masking would be a no-op); a
+                # tighter window uses the rolled path or dense fallback.
+                from mmlspark_tpu.ops.attention import decode_live_lengths
+                from mmlspark_tpu.ops.flash_attention import flash_decode
+
+                o = flash_decode(q, ck, cv, decode_live_lengths(pos, b))
             else:
                 o = dense_attention(q, ck, cv, causal=True,
                                     window=self.window, q_offset=pos)
@@ -220,13 +237,14 @@ class Block(nn.Module):
     rope: bool = False
 
     @nn.compact
-    def __call__(self, x, cache=None, pos=None, rolled=False):
+    def __call__(self, x, cache=None, pos=None, rolled=False,
+                 decode=False):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         attn = SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
             window=self.window, kv_heads=self.kv_heads, rope=self.rope,
             mesh=self.mesh, dtype=self.dtype, name="attn",
-        )(y, cache=cache, pos=pos, rolled=rolled)
+        )(y, cache=cache, pos=pos, rolled=rolled, decode=decode)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn

@@ -17,7 +17,54 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+# ---------------------------------------------------------------------------
+# masking values — ONE home for both conventions, so masks composed across
+# the dense (XLA) and Pallas paths can never mix semantics:
+#
+# - ``NEG_INF`` (true -inf) is the DENSE/XLA additive-mask value. The dense
+#   paths detect fully-masked rows exactly (``isneginf`` on the running max,
+#   ``denom == 0``) and emit zeros for them; exp(-inf - finite) is exactly 0.
+# - ``KERNEL_NEG_INF`` (finite -1e30) is the Pallas in-kernel stand-in. The
+#   blockwise kernels carry a running max initialized to it across grid
+#   iterations, and true -inf would poison that algebra the first time the
+#   update computes ``exp(m_prev - m_new)`` with both at -inf (inf - inf ->
+#   nan). -1e30 is far below any finite f32 score, so ``exp(s - m)``
+#   underflows to exactly 0.0 for masked entries; kernels detect
+#   fully-masked rows via ``l == 0`` (dead blocks are skipped, never
+#   accumulated), not via isneginf.
+#
+# Pick with :func:`mask_value`; never hard-code a third convention.
+
 NEG_INF = float("-inf")
+KERNEL_NEG_INF = -1e30
+
+
+def mask_value(*, kernel: bool) -> float:
+    """The additive value for dead attention scores: the finite Pallas
+    in-kernel stand-in when ``kernel=True`` (running-max algebra cannot
+    survive -inf minus -inf), true ``-inf`` for the dense/XLA paths
+    (which detect fully-masked rows exactly). See the module-level note
+    above for why the two must not mix."""
+    return KERNEL_NEG_INF if kernel else NEG_INF
+
+
+def decode_live_lengths(pos, batch: int):
+    """Per-row LIVE KV lengths for a single-token decode step writing at
+    absolute position ``pos``: the step's own K/V lands at ``pos``, so
+    positions ``[0, pos]`` are live — length ``pos + 1``.
+
+    This is the one definition of the decode off-by-one shared by the
+    dense cache read (``dense_attention(..., q_offset=pos)`` masks
+    ``kpos > pos``, i.e. keeps exactly ``pos + 1`` keys) and the
+    split-KV kernel (``flash_decode`` masks ``kpos >= length``), so the
+    two paths agree on which cache rows a step may see. ``pos`` is a
+    traced scalar or a per-row ``(B,)`` vector (the serving engine's
+    multi-tenant step); returns ``(batch,)`` int32.
+    """
+    pos = jnp.asarray(pos, jnp.int32)
+    if not pos.ndim:
+        pos = jnp.broadcast_to(pos, (batch,))
+    return pos + 1
 
 
 def causal_block_mask(q_len: int, kv_len: int, q_offset, kv_offset,

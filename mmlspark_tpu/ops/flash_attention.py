@@ -36,13 +36,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30  # finite: -inf minus -inf would poison the running max
+# the finite in-kernel masking value (-inf minus -inf would poison the
+# running max); ONE home for both masking conventions lives in
+# ops/attention.py — see the note there before touching either
+from mmlspark_tpu.ops.attention import KERNEL_NEG_INF as NEG_INF
+
 LANES = 128
+SUBLANES = 8  # min f32 sublane tile; single-row decode broadcasts to it
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept both so the
+# kernels import (and the interpret-mode CPU tests run) on either side
+# of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
 
 # all three kernels share a (batch·heads, outer-block, streamed-block)
 # grid: the first two dims own disjoint outputs/scratch, only the last
 # carries accumulator state across iterations
-_GRID_SEMANTICS = pltpu.CompilerParams(
+_GRID_SEMANTICS = _CompilerParams(
     dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY),
 )
 
@@ -549,3 +561,188 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
         interpret = not is_tpu()
     return _build(causal, window, scale, block, bool(interpret))(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# flash decode: split-KV single-token attention over slot caches
+#
+# The serving hot path (mmlspark_tpu/serve) decodes ONE query token per
+# slot per tick against a preallocated (B, cache_len, hk, d) cache, but a
+# dense read does cache_len worth of work per row no matter how little of
+# the buffer is live. This kernel streams K/V in blocks with the online-
+# softmax carry in VMEM scratch (same recipe as _fwd_kernel) and takes a
+# per-row LIVE-LENGTH vector (B,) int32 as a SCALAR-PREFETCH argument, so
+# the kv-block index map can clamp past each row's last live block —
+# consecutive dead grid iterations re-reference the resident tile and
+# their HBM→VMEM DMAs never issue. Work AND streamed bytes scale with
+# how much each request has actually generated, not with pool capacity.
+
+# grid (batch·heads, kv-block): only the streamed kv dim carries scratch
+_DECODE_SEMANTICS = _CompilerParams(
+    dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
+)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *,
+                   scale: float, blk: int, heads: int):
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    length = len_ref[bh // heads]  # live positions [0, length)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kb * blk < length)
+    def _update():
+        # the single query row broadcast to the minimum sublane tile:
+        # every scratch/compute shape stays (8, ·), all 8 rows identical
+        q = jnp.broadcast_to(q_ref[0], (SUBLANES, q_ref.shape[-1]))
+        s = jax.lax.dot_general(
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (8, blk) f32
+        kpos = kb * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos >= length, NEG_INF, s)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        # P·V stays f32 (unlike _fwd_kernel's native-dtype cast): the
+        # one-row decode matmul is bandwidth-bound — its FLOPs are noise
+        # next to the K/V stream — and f32 operands keep the kernel
+        # bit-compatible with the dense_attention oracle the serving
+        # parity tests hold it to
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _finalize():
+        # length == 0: no block ever updated, l stays 0 -> zeros, the
+        # same answer dense_attention gives a fully-masked row
+        l = l_scr[:1, :1]
+        o_ref[0] = (
+            acc_scr[:1] / jnp.where(l == 0.0, 1.0, l)
+        ).astype(o_ref.dtype)
+
+
+def _decode_block(cache_len: int, block: int) -> int:
+    """Largest divisor of ``cache_len`` in [8, block] when one exists —
+    dividing evenly means the cache streams with NO pad copy, which is
+    the point on the serving hot path; otherwise fall back to the padded
+    layout (_to_bh pads, masking hides the tail)."""
+    for cand in range(min(block, cache_len), 7, -1):
+        if cache_len % cand == 0:
+            return cand
+    return min(block, _round_up(cache_len, 8))
+
+
+def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
+                 interpret: bool | None = None):
+    """Length-aware split-KV attention for ONE query token per row.
+
+    ``q`` is (B, 1, H, D) — a single decode step; ``k``/``v`` are the
+    (B, L, Hkv, D) slot caches (GQA as in :func:`flash_attention`);
+    ``lengths`` is (B,) int32 of LIVE positions per row — row b attends
+    cache positions ``[0, lengths[b])`` and nothing else (the
+    ``pos + 1`` contract of :func:`mmlspark_tpu.ops.attention.
+    decode_live_lengths`). ``lengths[b] == 0`` yields zeros for that row,
+    matching the dense path's fully-masked convention.
+
+    The kv grid dimension streams L in blocks; ``lengths`` rides the
+    scalar-prefetch channel so the block index map clamps at each row's
+    last live block — blocks past the live length are never fetched from
+    HBM, making per-row work O(lengths[b]) instead of O(L). Inference
+    only (no VJP): this is the serving decode read, not a training op.
+
+    ``interpret=None`` auto-selects like :func:`flash_attention`:
+    compiled on TPU, interpreter elsewhere so CPU tests run the same
+    code path.
+    """
+    if not (q.dtype == k.dtype == v.dtype):
+        raise ValueError(
+            "flash_decode requires q, k, v to share one dtype, got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}"
+        )
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(
+            "flash_decode takes a SINGLE query token per row: q must be "
+            f"(B, 1, H, D), got {q.shape}"
+        )
+    if k.shape[2] != v.shape[2] or q.shape[2] % k.shape[2]:
+        raise ValueError(
+            "flash_decode needs k/v heads equal and dividing q heads, "
+            f"got q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
+        )
+    b, _, h, d = q.shape
+    L = k.shape[1]
+    lengths = jnp.asarray(lengths)
+    if lengths.shape != (b,):
+        raise ValueError(
+            f"lengths must be ({b},) — one live length per batch row — "
+            f"got {lengths.shape}"
+        )
+    g = h // k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        from mmlspark_tpu.core.env import is_tpu
+
+        interpret = not is_tpu()
+    lengths = jnp.clip(lengths.astype(jnp.int32), 0, L)
+
+    blk = _decode_block(L, block)
+    l_pad = _round_up(L, blk)
+    qb = _to_bh(q, 1)          # (B*H, 1, D)
+    kb = _to_bh(k, l_pad)      # (B*Hkv, l_pad, D)
+    vb = _to_bh(v, l_pad)
+    n_blk = l_pad // blk
+
+    def kv_im(bh, j, lens):
+        # clamp at the row's last LIVE block: dead iterations re-reference
+        # the resident tile, so their DMAs never issue (block-level
+        # early-out). bh // g maps query-head rows onto kv-head rows
+        # (bh//g == batch*hkv + qh//group, g dividing h).
+        length = lens[bh // h]
+        last = jnp.maximum((length + blk - 1) // blk - 1, 0)
+        return (bh // g, jnp.minimum(j, last), 0)
+
+    out = pl.pallas_call(
+        partial(_decode_kernel, scale=scale, blk=blk, heads=h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, n_blk),
+            in_specs=[
+                pl.BlockSpec((1, 1, d), lambda bh, j, lens: (bh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, blk, d), kv_im,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, blk, d), kv_im,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, d), lambda bh, j, lens: (bh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((SUBLANES, LANES), jnp.float32),  # running max
+                pltpu.VMEM((SUBLANES, LANES), jnp.float32),  # normalizer
+                pltpu.VMEM((SUBLANES, d), jnp.float32),      # accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        compiler_params=_DECODE_SEMANTICS,
+        interpret=bool(interpret),
+    )(lengths, qb, kb, vb)
+    return _from_bh(out, b, h, 1)

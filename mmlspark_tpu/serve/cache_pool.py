@@ -58,8 +58,13 @@ class SlotCachePool:
         self.cache_len = cache_len
         self.buffers = {}
         for name, (hk, d) in geometry.items():
-            buf = jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16)
-            self.buffers[name] = (buf, buf)
+            # K and V must be DISTINCT arrays: the engine's decode step
+            # donates the whole buffer pytree (donate_argnums), and a
+            # pair aliasing one allocation cannot be donated twice
+            self.buffers[name] = (
+                jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16),
+                jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16),
+            )
         # LIFO free list popping the lowest id first keeps slot
         # assignment deterministic for the parity tests
         self._free = list(range(slots - 1, -1, -1))

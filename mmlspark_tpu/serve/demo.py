@@ -63,6 +63,8 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         max_new_tokens=max_new_tokens,
         cache_len=cache_len,
         decode_compiles=engine.decode_compile_count,
+        prefill_compiles=engine.prefill_compile_count,
+        prefill_bucket_count=engine.num_prefill_buckets,
         model_config={"vocab": vocab, "d_model": d_model, "heads": heads,
                       "depth": depth},
     )

@@ -5,9 +5,15 @@ into a multi-tenant engine: requests of different prompt lengths and
 arrival times share ONE jitted decode step over the slot pool's
 fixed-shape buffers, so XLA compiles the decode program exactly once per
 engine (asserted by ``tests/test_serve.py`` via
-``decode_compile_count``). Prefill is its own jitted program, retraced
-per distinct prompt length — the classic serving trade: joiners pay a
-length-bucketed prefill, the steady-state decode tick never recompiles.
+``decode_compile_count``). Prefill is its own jitted program, BUCKETED
+by prompt length: prompts right-pad to power-of-two buckets, so at most
+O(log cache_len) prefill programs ever compile
+(``prefill_compile_count`` <= ``num_prefill_buckets``) — joiners pay a
+bucketed prefill, the steady-state decode tick never recompiles. The
+decode step reads each slot's cache through the length-aware split-KV
+kernel (``ops/flash_attention.flash_decode``) and DONATES the pool's
+buffer pytree, so K/V update in place on device (docs/SERVING.md has
+the donation contract).
 
 Usage::
 
@@ -92,20 +98,37 @@ class ServeEngine:
                                                max_queue=max_queue)
         self._next_id = 0
 
-        def _prefill(variables, prompt):
-            # (1, P) -> first greedy token + a length-P linear cache;
-            # jit retraces per distinct P (length-bucketed prefill)
+        # bucketed prefill: prompts are right-padded to power-of-two
+        # length buckets, so the prefill program count is O(log
+        # cache_len) instead of O(distinct prompt lengths). Causality
+        # makes the pads invisible: pad positions sit AFTER every real
+        # token, the real positions' K/V and logits cannot see them, and
+        # ``last`` (traced, so no retrace per value) slices the true
+        # last-token logits out of the padded row. MoE models opt out —
+        # their expert-capacity routing is not causal (a pad consumes
+        # capacity that can change a REAL token's expert), so they keep
+        # exact-length prefill.
+        self._bucketed = not graph.extra.get("n_experts")
+
+        def _prefill(variables, prompt, last):
+            # (1, B) padded prompt -> first greedy token (from position
+            # ``last``, the true prompt end) + a length-B linear cache;
+            # jit retraces per distinct BUCKET
             cache = init_cache(graph, variables, 1, prompt.shape[1])
             logits, cache = _cached_apply(graph, variables, prompt,
                                           cache, 0)
-            first = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+            cur = jax.lax.dynamic_slice_in_dim(
+                logits, last, 1, axis=1
+            )[:, 0]
+            first = jnp.argmax(cur.astype(jnp.float32), axis=-1)
             return first.astype(jnp.int32), cache
 
         def _decode(variables, buffers, tok, pos):
             # ONE fused single-token step for every slot: tok/pos are
             # (S,) and every slot decodes at its own absolute position
-            # (per-row q_offset through ops/attention.py). Fixed shapes
-            # -> compiled exactly once.
+            # (per-row live lengths through ops/flash_attention.py's
+            # flash_decode — work per row scales with its live tokens,
+            # not cache_len). Fixed shapes -> compiled exactly once.
             logits, buffers = _cached_apply(
                 graph, variables, tok[:, None], buffers, pos, step=True
             )
@@ -113,7 +136,35 @@ class ServeEngine:
             return nxt.astype(jnp.int32), buffers
 
         self._prefill = jax.jit(_prefill)
-        self._decode = jax.jit(_decode)
+        # the slot-pool cache pytree is DONATED through the decode step:
+        # K/V buffers update in place on device instead of being copied
+        # each tick. Contract: the engine immediately rebinds
+        # ``pool.buffers`` to the step's outputs and nothing else may
+        # hold the donated references (docs/SERVING.md).
+        self._decode = jax.jit(_decode, donate_argnums=(1,))
+
+    # -- prefill buckets ---------------------------------------------------
+
+    def prefill_bucket(self, prompt_len: int) -> int:
+        """Padded length the prefill program runs at for a prompt of
+        ``prompt_len``: the next power of two >= max(prompt_len, 8),
+        capped at ``cache_len`` (admission control guarantees
+        prompt_len < cache_len, so the cap always covers the prompt).
+        MoE engines bucket at exact length (see ``__init__``)."""
+        if not self._bucketed:
+            return prompt_len
+        bucket = 8
+        while bucket < prompt_len:
+            bucket *= 2
+        return min(bucket, self.cache_len)
+
+    @property
+    def num_prefill_buckets(self) -> int:
+        """How many distinct prefill programs CAN exist for this engine
+        — the ceiling the compile-guard tests pin prefill to."""
+        return len({
+            self.prefill_bucket(p) for p in range(1, self.cache_len)
+        })
 
     # -- introspection -----------------------------------------------------
 
@@ -135,6 +186,14 @@ class ServeEngine:
         continuous-batching invariant says this stays 1 for the life of
         the engine (asserted in tests)."""
         cache_size = getattr(self._decode, "_cache_size", None)
+        return cache_size() if callable(cache_size) else -1
+
+    @property
+    def prefill_compile_count(self) -> int:
+        """How many prefill programs have compiled — bounded by
+        ``num_prefill_buckets`` for the life of the engine (asserted in
+        tests), however many distinct prompt lengths arrive."""
+        cache_size = getattr(self._prefill, "_cache_size", None)
         return cache_size() if callable(cache_size) else -1
 
     # -- public API --------------------------------------------------------
@@ -208,18 +267,30 @@ class ServeEngine:
                 req = self._sched.pop_next()
                 slot = self.pool.lease()
                 with annotate("serve.prefill"):
+                    p = len(req.prompt)
+                    bucket = self.prefill_bucket(p)
+                    padded = np.full((bucket,), self.pad_id, np.int32)
+                    padded[:p] = req.prompt
                     first, cache = self._prefill(
-                        self.variables, jnp.asarray(req.prompt[None])
+                        self.variables, jnp.asarray(padded[None]), p - 1
                     )
-                    self.pool.write_prefill(slot, cache, len(req.prompt))
+                    # only the REAL prompt's K/V enter the slot; the pad
+                    # tail of the bucket cache is dropped here
+                    self.pool.write_prefill(slot, cache, p)
                     first = int(first[0])
-                self.metrics.record_first_token(req, tick)
+                self.metrics.record_first_token(req, tick, bucket=bucket)
                 done = self._sched.activate(slot, req, first, tick)
                 if done is not None:
                     finished.append(done)
 
         if self._sched.active:
             n_active = len(self._sched.active)
+            # live KV rows this step actually attends (pos + 1 per
+            # active slot) vs the dense-over-cache_len read it replaced
+            # — the decode FLOP-utilization figure in the metrics
+            live_kv = sum(
+                st.pos + 1 for st in self._sched.active.values()
+            )
             tok, pos = self._sched.decode_inputs(self.pad_id)
             with annotate("serve.decode"):
                 td = time.perf_counter()
@@ -227,10 +298,13 @@ class ServeEngine:
                     self.variables, self.pool.buffers,
                     jnp.asarray(tok), jnp.asarray(pos),
                 )
+                # the inputs were DONATED: rebind the pool to the step's
+                # outputs before anything can touch the stale references
                 self.pool.buffers = buffers
                 nxt = np.asarray(nxt)  # host sync: (S,) int32 only
                 self.metrics.record_decode(
-                    n_active, time.perf_counter() - td
+                    n_active, time.perf_counter() - td,
+                    live_kv=live_kv, cache_len=self.cache_len,
                 )
             finished.extend(self._sched.consume(nxt, tick))
 

@@ -42,6 +42,14 @@ class ServeMetrics:
         self.ttft_s: list[float] = []
         self.decode_seconds = 0.0
         self.decode_tokens = 0
+        # length-aware decode accounting: KV rows the split-KV kernel
+        # actually read vs what a dense read over the full cache_len
+        # would have touched for the same steps
+        self.decode_live_kv = 0
+        self.decode_dense_kv = 0
+        #: prefill count per padded bucket length (str keys: the dict
+        #: rides the flat JSON line as-is)
+        self.prefill_buckets: dict[str, int] = {}
         self._t0: float | None = None
         self._t_last: float | None = None
 
@@ -60,14 +68,23 @@ class ServeMetrics:
     def record_reject(self) -> None:
         self.rejected += 1
 
-    def record_first_token(self, req, tick: int) -> None:
+    def record_first_token(self, req, tick: int,
+                           bucket: int | None = None) -> None:
         self.prefills += 1
         self.ttft_ticks.append(tick - req.submit_tick)
         self.ttft_s.append(time.perf_counter() - req.submit_wall)
+        if bucket is not None:
+            key = str(bucket)
+            self.prefill_buckets[key] = self.prefill_buckets.get(key, 0) + 1
 
-    def record_decode(self, n_active: int, seconds: float) -> None:
+    def record_decode(self, n_active: int, seconds: float,
+                      live_kv: int | None = None,
+                      cache_len: int | None = None) -> None:
         self.decode_seconds += seconds
         self.decode_tokens += n_active
+        if live_kv is not None and cache_len is not None:
+            self.decode_live_kv += live_kv
+            self.decode_dense_kv += n_active * cache_len
 
     def record_finish(self, result) -> None:
         if result.status == "expired":
@@ -130,6 +147,18 @@ class ServeMetrics:
                 round(self.tokens_generated / wall, 1) if wall > 0 else None
             ),
             "wall_s": round(wall, 4),
+            # what fraction of a dense-over-cache_len read's attention
+            # work the length-aware decode actually performed: KV rows
+            # LIVE at each step / slots * cache_len rows a dense read
+            # touches — the direct measure of what flash_decode's
+            # block-level early-out saves
+            "decode_live_kv_tokens": self.decode_live_kv,
+            "decode_dense_kv_tokens": self.decode_dense_kv,
+            "decode_flop_utilization": (
+                round(self.decode_live_kv / self.decode_dense_kv, 4)
+                if self.decode_dense_kv else None
+            ),
+            "prefill_buckets": dict(self.prefill_buckets),
         }
 
     def snapshot(self) -> list[MetricData]:

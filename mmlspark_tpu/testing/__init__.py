@@ -1,2 +1,10 @@
 """Test support library (shipped, like the reference's core/test/{base,
-datagen,fuzzing} sbt projects — SURVEY.md §2/L9)."""
+datagen,fuzzing} sbt projects — SURVEY.md §2/L9).
+
+``compile_guard`` pins jitted program counts across a block of work —
+the serving engine's compile-once invariants live there.
+"""
+
+from mmlspark_tpu.testing.compile_guard import compile_guard
+
+__all__ = ["compile_guard"]

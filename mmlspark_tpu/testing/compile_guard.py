@@ -1,0 +1,60 @@
+"""Compile-count guard: assert a jitted program's cache stays bounded
+across a block of work.
+
+The serving engine's whole design rests on compile-count invariants —
+the fused decode step compiles EXACTLY once no matter how requests join
+and leave, and bucketed prefill compiles at most once per length bucket
+(docs/SERVING.md). Those invariants used to be asserted ad hoc at the
+end of individual tests; this context manager makes them reusable and
+makes the failure mode loud and specific::
+
+    with compile_guard(lambda: engine.decode_compile_count,
+                       max_programs=1, min_programs=1, label="decode"):
+        ... drive traffic ...
+
+Any callable returning a monotonically non-decreasing program count
+works — ``ServeEngine.decode_compile_count`` / ``prefill_compile_count``
+wrap jax's ``jitted._cache_size()``, and a raw ``f._cache_size`` does
+too. The guard checks the DELTA across the block, so engines with prior
+traffic can still be guarded for "no NEW programs" (``max_programs=0``).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Callable, Iterator
+
+
+@contextmanager
+def compile_guard(count_fn: Callable[[], int], *, max_programs: int,
+                  min_programs: int = 0,
+                  label: str = "jitted program") -> Iterator[None]:
+    """Assert that at most ``max_programs`` (and at least
+    ``min_programs``) NEW programs compile inside the block.
+
+    ``count_fn`` is sampled on entry and exit; the delta is what is
+    asserted, as a plain ``AssertionError`` so pytest renders it like
+    any inline assert. Exceptions from the block propagate untouched —
+    a failing body should fail as itself, not as a compile-count
+    message.
+    """
+    if max_programs < min_programs:
+        raise ValueError(
+            f"max_programs ({max_programs}) < min_programs "
+            f"({min_programs})"
+        )
+    before = count_fn()
+    yield
+    grown = count_fn() - before
+    if grown > max_programs:
+        raise AssertionError(
+            f"{label}: {grown} programs compiled, expected at most "
+            f"{max_programs} — a shape or static argument is varying "
+            "across calls that the design says must share one program"
+        )
+    if grown < min_programs:
+        raise AssertionError(
+            f"{label}: {grown} programs compiled, expected at least "
+            f"{min_programs} — the guarded block never reached the "
+            "jitted path it was meant to exercise"
+        )

@@ -24,6 +24,7 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.metrics_contracts import MetricData
 from mmlspark_tpu.models import build_model, generate
 from mmlspark_tpu.serve import ServeEngine, SlotCachePool
+from mmlspark_tpu.testing.compile_guard import compile_guard
 
 PERIOD = 4
 
@@ -101,13 +102,15 @@ def test_staggered_arrivals_match_generate(config):
     engine = ServeEngine(m, v, slots=2, cache_len=32)
     results = {}
     rid_to_idx = {}
-    for i, p in enumerate(prompts):  # staggered: one submit per tick
-        rid_to_idx[engine.submit(p, max_new_tokens=8)] = i
-        for res in engine.step():
-            results[res.id] = res
-    while engine.busy:
-        for res in engine.step():
-            results[res.id] = res
+    with compile_guard(lambda: engine.decode_compile_count,
+                       max_programs=1, min_programs=1, label="decode"):
+        for i, p in enumerate(prompts):  # staggered: one submit per tick
+            rid_to_idx[engine.submit(p, max_new_tokens=8)] = i
+            for res in engine.step():
+                results[res.id] = res
+        while engine.busy:
+            for res in engine.step():
+                results[res.id] = res
 
     assert len(results) == 3
     for rid, res in results.items():
@@ -115,7 +118,6 @@ def test_staggered_arrivals_match_generate(config):
         np.testing.assert_array_equal(
             np.asarray(res.tokens), want[rid_to_idx[rid]]
         )
-    assert engine.decode_compile_count == 1
 
 
 def test_more_requests_than_slots_still_match():
@@ -235,6 +237,66 @@ def test_metrics_dict_and_snapshot():
     assert all(r.group == "serve" for r in records)
     names = {r.name for r in records}
     assert "serve.completed" in names and "serve.per_token_ms" in names
+
+
+# -- compile-count invariants (bucketed prefill + fused decode) -------------
+
+
+def test_mixed_length_soak_pins_compile_counts():
+    """Soak with mixed-length joiners: every distinct prompt length in
+    [1, 12] flows through 2 slots. The fused decode step must compile
+    exactly once and bucketed prefill at most once per power-of-two
+    bucket — NOT once per distinct length — while every request still
+    matches single-request ``generate()`` byte for byte."""
+    m = _tiny()
+    v, ids = _train_lm(m)
+    lengths = [4, 1, 12, 7, 8, 3, 10, 2, 5, 9]  # raggedy on purpose
+    prompts = [np.asarray(ids[0, :n]) for n in lengths]
+    engine = ServeEngine(m, v, slots=2, cache_len=32, max_queue=16)
+    assert engine.num_prefill_buckets == 3  # 8, 16, 32
+    rids = []
+    with compile_guard(lambda: engine.decode_compile_count,
+                       max_programs=1, min_programs=1, label="decode"), \
+         compile_guard(lambda: engine.prefill_compile_count,
+                       max_programs=engine.num_prefill_buckets,
+                       min_programs=1, label="prefill"):
+        results = {}
+        for i, p in enumerate(prompts):  # two joiners per tick
+            rids.append(engine.submit(p, max_new_tokens=4))
+            if i % 2:
+                results.update({r.id: r for r in engine.step()})
+        results.update(engine.run())
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(generate(m, v, p[None], max_new_tokens=4))[0]
+        np.testing.assert_array_equal(np.asarray(results[rid].tokens), want)
+    # the 10 distinct lengths landed in at most 2 buckets (8 and 16):
+    # far fewer programs than the per-length prefill would have traced
+    assert engine.prefill_compile_count <= 2
+    buckets = engine.metrics.prefill_buckets
+    assert set(buckets) <= {"8", "16"}
+    assert sum(buckets.values()) == len(prompts)
+    # length-aware decode touched strictly less KV than a dense read
+    d = engine.metrics.to_dict()
+    assert 0.0 < d["decode_flop_utilization"] < 1.0
+    assert d["decode_live_kv_tokens"] < d["decode_dense_kv_tokens"]
+
+
+def test_compile_guard_raises_on_violation():
+    calls = {"n": 0}
+
+    def count():
+        return calls["n"]
+
+    with pytest.raises(AssertionError, match="at most"):
+        with compile_guard(count, max_programs=0, label="demo"):
+            calls["n"] += 1
+    with pytest.raises(AssertionError, match="at least"):
+        with compile_guard(count, max_programs=3, min_programs=1,
+                           label="demo"):
+            pass
+    with pytest.raises(ValueError, match="max_programs"):
+        with compile_guard(count, max_programs=0, min_programs=1):
+            pass
 
 
 # -- soak / CLI (slow tier) ------------------------------------------------
