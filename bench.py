@@ -730,7 +730,11 @@ def bench_serve(jax) -> dict:
     have) plus the raw ``decode_live_kv_tokens`` /
     ``decode_dense_kv_tokens`` counters, and ``prefill_buckets`` maps
     each padded bucket length to how many prompts landed in it — all
-    persisted in this group's ``serve`` scratch key as-is."""
+    persisted in this group's ``serve`` scratch key as-is. With
+    ``MMLTPU_TELEMETRY_DIR`` set (the CLI's ``--telemetry-dir``), the
+    engine's flight-recorder span timeline lands in ``events.jsonl``
+    and the metrics dict in ``metrics.json`` under it, next to the
+    one-line JSON this process emits (docs/OBSERVABILITY.md)."""
     from mmlspark_tpu.serve.demo import run_demo
 
     full = _full_scale(jax)
@@ -744,6 +748,7 @@ def bench_serve(jax) -> dict:
         heads=8 if full else 2,
         depth=8 if full else 2,
         cache_len=128 if full else 32,
+        telemetry_dir=os.environ.get("MMLTPU_TELEMETRY_DIR") or None,
     )
     return {"serve": out}
 

@@ -48,6 +48,11 @@ def cmd_run(args) -> int:
 
 def cmd_bench(args) -> int:
     _apply_backend(args)
+    if getattr(args, "telemetry_dir", None):
+        # bench.py runs via runpy (and re-execs itself on retry), so the
+        # flag travels through the environment; the serve metric group
+        # writes events.jsonl + metrics.json under it
+        os.environ["MMLTPU_TELEMETRY_DIR"] = args.telemetry_dir
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     bench = os.path.join(repo, "bench.py")
     if not os.path.exists(bench):
@@ -71,6 +76,7 @@ def cmd_serve(args) -> int:
         max_new_tokens=args.max_new_tokens,
         arrivals_per_tick=args.arrivals_per_tick,
         seed=args.seed,
+        telemetry_dir=args.telemetry_dir or None,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -167,6 +173,11 @@ def main(argv: list[str] | None = None) -> int:
     sp.set_defaults(fn=cmd_run)
 
     sp = sub.add_parser("bench", help="run the repo benchmark")
+    sp.add_argument(
+        "--telemetry-dir", default="", metavar="DIR",
+        help="write the serve group's events.jsonl + metrics.json "
+        "telemetry under DIR (docs/OBSERVABILITY.md)",
+    )
     sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser(
@@ -183,6 +194,12 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--max-new-tokens", type=int, default=8)
     sp.add_argument("--arrivals-per-tick", type=int, default=2)
     sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument(
+        "--telemetry-dir", default="", metavar="DIR",
+        help="write events.jsonl (per-request trace spans) and "
+        "metrics.json (latency percentiles) under DIR "
+        "(docs/OBSERVABILITY.md)",
+    )
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(

@@ -1,0 +1,478 @@
+"""Unified telemetry plane: metric registry, trace spans, flight
+recorder, retrace watchdog.
+
+The reference's only observability was the Timer stage's wall-clock
+logging (SURVEY.md §5). This module is the shared layer every plane of
+the reproduction records into — the serving engine emits one span per
+request lifecycle, the trainer records step-time/loss/grad-norm
+histograms, and ``bench.py``/the CLI persist ``events.jsonl`` +
+``metrics.json`` under ``--telemetry-dir`` — following the lineage's
+production systems (TensorFlow ships structured runtime metrics and
+tracing as core infrastructure, arXiv:1605.08695 §9).
+
+Four pieces, deliberately dependency-free (stdlib only; jax is touched
+lazily and only by the watchdog's shape formatter):
+
+- :class:`MetricRegistry` with :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` primitives. Histograms use DETERMINISTIC
+  log-bucketed bins: same samples -> same quantiles, independent of
+  arrival order, with bounded relative error (one bucket's growth
+  factor) and exact count/sum/min/max.
+- :class:`Span` + :class:`SpanTracer`: structured events (name, attrs,
+  tick, monotonic wall time) grouped by span id.
+- :class:`FlightRecorder`: a bounded ring buffer of those events that
+  can dump the last N as JSON-lines on demand
+  (:meth:`FlightRecorder.dump`) and automatically when a
+  :class:`FriendlyError` escapes a guarded block
+  (:meth:`FlightRecorder.dump_on_friendly_error`) — the post-mortem
+  answer to "why was this request slow / what happened right before
+  the failure".
+- :class:`RetraceWatchdog`: wraps a jitted callable (reusing
+  ``testing/compile_guard.py``'s program counting) and logs every NEW
+  XLA compilation with the triggering abstract shapes/dtypes — silent
+  retraces are the classic TPU serving regression and this makes them
+  loud at the moment they happen.
+
+``utils/profiling.py`` re-exports everything here next to the
+jax.profiler hooks, so call sites have one observability import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.metrics_contracts import MetricData
+
+_log = get_logger("telemetry")
+
+
+# --------------------------------------------------------------------------
+# metric primitives
+# --------------------------------------------------------------------------
+
+
+class Counter:
+    """Monotonic counter. ``inc`` only; resets belong to a new registry."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depth, utilization, ...)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: float | None = None
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> float | None:
+        return self._value
+
+
+class Histogram:
+    """Log-bucketed latency/size histogram with deterministic quantiles.
+
+    Buckets are fixed at construction: bucket ``i`` covers
+    ``(lo * growth**(i-1), lo * growth**i]``, values ``<= lo`` land in
+    bucket 0 and values above the top edge in the last (overflow)
+    bucket. Quantiles walk the cumulative counts and return the
+    bucket's geometric midpoint, clamped into the exactly-tracked
+    ``[min, max]`` — so two histograms fed the same samples in ANY
+    order report identical p50/p95/p99, and the relative error is
+    bounded by one ``growth`` factor (default 10%).
+    """
+
+    def __init__(self, name: str, *, lo: float = 1e-3, hi: float = 1e8,
+                 growth: float = 1.1):
+        if not (lo > 0 and hi > lo and growth > 1.0):
+            raise FriendlyError(
+                f"histogram '{name}' needs 0 < lo < hi and growth > 1, "
+                f"got lo={lo} hi={hi} growth={growth}"
+            )
+        self.name = name
+        self.lo = lo
+        self.growth = growth
+        self._log_growth = math.log(growth)
+        self.n_buckets = 2 + math.ceil(math.log(hi / lo) / self._log_growth)
+        self._counts = [0] * self.n_buckets
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    def _bucket(self, value: float) -> int:
+        if value <= self.lo:
+            return 0
+        idx = 1 + int(math.ceil(math.log(value / self.lo) / self._log_growth
+                                - 1e-12))
+        return min(idx, self.n_buckets - 1)
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        self._counts[self._bucket(value)] += 1
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    def percentile(self, p: float) -> float | None:
+        """Deterministic quantile estimate; None while empty."""
+        if not self.count:
+            return None
+        rank = max(1, math.ceil(p / 100.0 * self.count))
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                if i == 0:
+                    est = self.lo
+                else:
+                    # geometric midpoint of the bucket's edges
+                    est = self.lo * self.growth ** (i - 0.5)
+                return min(max(est, self.min), self.max)
+        return self.max  # unreachable; defensive
+
+    @property
+    def mean(self) -> float | None:
+        return (self.sum / self.count) if self.count else None
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "mean": round(self.mean, 6) if self.count else None,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricRegistry:
+    """Name -> metric map; get-or-create with type checking.
+
+    One process-wide default lives behind :func:`default_registry`;
+    subsystems that need isolation (one registry per ``ServeEngine``,
+    per ``SPMDTrainer``) construct their own.
+    """
+
+    def __init__(self):
+        self._metrics: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise FriendlyError(
+                    f"metric '{name}' is already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str, **kwargs) -> Histogram:
+        return self._get_or_create(name, Histogram, **kwargs)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._metrics)
+
+    def to_dict(self) -> dict:
+        """Flat JSON-able view: counters/gauges as scalars, histograms
+        expanded to ``<name>_{count,mean,p50,p95,p99}``."""
+        out: dict[str, Any] = {}
+        for name in self.names():
+            m = self._metrics[name]
+            if isinstance(m, Histogram):
+                s = m.summary()
+                for k in ("count", "mean", "p50", "p95", "p99"):
+                    out[f"{name}_{k}"] = s[k]
+            else:
+                out[name] = m.value
+        return out
+
+    def snapshot(self, model: str | None = None,
+                 group: str | None = None) -> list[MetricData]:
+        """Structured records: scalars via ``MetricData.create``-style
+        rows, histograms as ``MetricData.create_table`` summaries."""
+        out: list[MetricData] = []
+        for name in self.names():
+            m = self._metrics[name]
+            if isinstance(m, Histogram):
+                out.append(MetricData.create_table(name, m.summary(), model))
+            elif m.value is not None:
+                out.append(MetricData(name=name, value=float(m.value),
+                                      model=model, group=group))
+        return out
+
+
+_DEFAULT_REGISTRY = MetricRegistry()
+
+
+def default_registry() -> MetricRegistry:
+    """The process-wide registry (ad-hoc call sites; subsystems that
+    need isolation build their own)."""
+    return _DEFAULT_REGISTRY
+
+
+# --------------------------------------------------------------------------
+# spans + flight recorder
+# --------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring buffer of structured events.
+
+    Each event is one flat dict: ``t`` (monotonic seconds), ``name``,
+    optional ``tick`` / ``span`` / ``span_name``, and a nested
+    ``attrs`` dict. The buffer keeps the LAST ``capacity`` events
+    (``dropped`` counts evictions) so a long-running engine's recorder
+    is always a post-mortem of the recent past, never an unbounded log.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise FriendlyError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._events: deque[dict] = deque(maxlen=capacity)
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def record(self, name: str, *, tick: int | None = None,
+               span: int | None = None, span_name: str | None = None,
+               **attrs) -> None:
+        ev: dict[str, Any] = {"t": time.monotonic(), "name": name}
+        if tick is not None:
+            ev["tick"] = tick
+        if span is not None:
+            ev["span"] = span
+        if span_name is not None:
+            ev["span_name"] = span_name
+        if attrs:
+            ev["attrs"] = attrs
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self, path: str | None = None) -> str:
+        """The last N events as JSON-lines; written to ``path`` when
+        given, returned either way."""
+        lines = "\n".join(
+            json.dumps(ev, default=str) for ev in self.events()
+        )
+        if lines:
+            lines += "\n"
+        if path is not None:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(lines)
+            _log.info("flight recorder: %d events -> %s",
+                      len(self._events), path)
+        return lines
+
+    @contextlib.contextmanager
+    def dump_on_friendly_error(
+        self, path: str | None = None,
+        exc_types: tuple = (FriendlyError,),
+    ) -> Iterator["FlightRecorder"]:
+        """Re-raise any :class:`FriendlyError` escaping the block after
+        dumping the ring buffer — the black-box recorder contract: the
+        crash itself triggers the evidence dump."""
+        try:
+            yield self
+        except exc_types as e:
+            dumped = self.dump(path)
+            if path is None:
+                _log.error(
+                    "flight recorder dump on %s (last %d events):\n%s",
+                    type(e).__name__, len(self._events), dumped,
+                )
+            raise
+
+
+class Span:
+    """One traced unit of work (a serve request, a train step group).
+
+    Not a context manager on purpose: serving spans live across many
+    engine ticks, so the lifecycle is explicit — ``event()`` per phase,
+    ``end()`` exactly once with the terminal status.
+    """
+
+    def __init__(self, recorder: FlightRecorder, name: str, span_id: int,
+                 tick: int | None = None, **attrs):
+        self._recorder = recorder
+        self.name = name
+        self.id = span_id
+        self.t0 = time.monotonic()
+        self.ended = False
+        self._recorder.record("start", tick=tick, span=span_id,
+                              span_name=name, **attrs)
+
+    def event(self, name: str, *, tick: int | None = None, **attrs) -> None:
+        self._recorder.record(name, tick=tick, span=self.id,
+                              span_name=self.name, **attrs)
+
+    def end(self, status: str = "ok", *, tick: int | None = None,
+            **attrs) -> None:
+        if self.ended:
+            return
+        self.ended = True
+        self._recorder.record(
+            status, tick=tick, span=self.id, span_name=self.name,
+            duration_ms=round((time.monotonic() - self.t0) * 1e3, 3),
+            **attrs,
+        )
+
+
+class SpanTracer:
+    """Hands out :class:`Span` objects with process-unique ids over one
+    :class:`FlightRecorder`."""
+
+    def __init__(self, recorder: FlightRecorder):
+        self.recorder = recorder
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def span(self, name: str, *, tick: int | None = None, **attrs) -> Span:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        return Span(self.recorder, name, sid, tick=tick, **attrs)
+
+
+# --------------------------------------------------------------------------
+# retrace watchdog
+# --------------------------------------------------------------------------
+
+
+def _describe_abstract(args: tuple, kwargs: dict, limit: int = 12) -> str:
+    """``bf16[4,64,2,16]``-style rendering of a call's array leaves —
+    the abstract signature jax traced, which is exactly what decides
+    whether a call hits the jit cache."""
+    import numpy as np
+
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    except Exception:  # noqa: BLE001 — formatting must never raise
+        leaves = [a for a in args if hasattr(a, "shape")]
+    parts = []
+    for leaf in leaves[:limit]:
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            parts.append(repr(leaf)[:32])
+            continue
+        dtype = getattr(leaf, "dtype", np.asarray(leaf).dtype)
+        parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+    if len(leaves) > limit:
+        parts.append(f"... +{len(leaves) - limit} leaves")
+    return ", ".join(parts)
+
+
+class RetraceWatchdog:
+    """Wrap a jitted callable; log every NEW XLA compilation.
+
+    Counting reuses the same ``jitted._cache_size()`` contract
+    ``testing/compile_guard.py`` pins invariants with
+    (:func:`mmlspark_tpu.testing.compile_guard.jit_cache_size`): the
+    cache size is sampled after each call, and growth means the call's
+    abstract shapes/dtypes missed the cache — the first program logs at
+    INFO (expected warm-up), every later one at WARNING (a retrace the
+    design probably forbids), both with the triggering signature.
+    Optionally mirrors into a registry counter and a flight-recorder
+    event, so a retrace shows up in the same ``events.jsonl`` timeline
+    as the request that caused it.
+    """
+
+    def __init__(self, fn: Callable, label: str, *,
+                 registry: MetricRegistry | None = None,
+                 recorder: FlightRecorder | None = None):
+        from mmlspark_tpu.testing.compile_guard import jit_cache_size
+
+        self._fn = fn
+        self._size_of = jit_cache_size
+        self.label = label
+        self.compilations = 0  # programs seen by THIS wrapper
+        self._counter = (
+            registry.counter(f"retrace.{label}")
+            if registry is not None else None
+        )
+        self._recorder = recorder
+        self._seen = max(0, jit_cache_size(fn))
+
+    @property
+    def retraces(self) -> int:
+        """Compilations beyond the expected first program."""
+        return max(0, self.compilations - 1)
+
+    def _cache_size(self) -> int:
+        """compile_guard-compatible counting passthrough."""
+        return self._size_of(self._fn)
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        n = self._size_of(self._fn)
+        if n > self._seen:
+            new = n - self._seen
+            self.compilations += new
+            self._seen = n
+            sig = _describe_abstract(args, kwargs)
+            level = _log.info if self.compilations == new else _log.warning
+            level(
+                "retrace[%s]: %d new XLA program(s) compiled (total %d) "
+                "for abstract signature (%s)",
+                self.label, new, n, sig,
+            )
+            if self._counter is not None:
+                self._counter.inc(new)
+            if self._recorder is not None:
+                self._recorder.record(
+                    "retrace", label=self.label, new_programs=new,
+                    total_programs=n, signature=sig,
+                )
+        return out
+
+
+def watch_retrace(fn: Callable, label: str, *,
+                  registry: MetricRegistry | None = None,
+                  recorder: FlightRecorder | None = None) -> RetraceWatchdog:
+    """Functional spelling of :class:`RetraceWatchdog` (``jax.jit``-like
+    wrap-at-definition call sites read better with a function)."""
+    return RetraceWatchdog(fn, label, registry=registry, recorder=recorder)

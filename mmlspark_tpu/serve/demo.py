@@ -5,10 +5,17 @@ Drives a ``ServeEngine`` over a small random-init ``transformer_lm``
 with a deterministic staggered arrival schedule (a few submits per tick,
 prompt lengths drawn from a seeded rng), mirroring ``bench``'s contract:
 ONE parseable JSON line out, carrying queue-depth, TTFT, per-token
-latency, slot-utilization, and throughput metrics.
+latency, slot-utilization, and throughput metrics. With
+``telemetry_dir`` set (the CLI's ``--telemetry-dir``), the engine's
+flight-recorder event timeline lands in ``events.jsonl`` and the full
+metrics dict in ``metrics.json`` next to it — the schema
+``tools/check_metrics_schema.py`` gates (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
+
+import json
+import os
 
 import numpy as np
 
@@ -17,7 +24,8 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              max_new_tokens: int = 8, arrivals_per_tick: int = 2,
              vocab: int = 64, d_model: int = 32, heads: int = 2,
              depth: int = 2, cache_len: int = 64, seed: int = 0,
-             deadline_ticks: int | None = None) -> dict:
+             deadline_ticks: int | None = None,
+             telemetry_dir: str | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line."""
     import jax
@@ -68,4 +76,10 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         model_config={"vocab": vocab, "d_model": d_model, "heads": heads,
                       "depth": depth},
     )
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+        engine.recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
+        with open(os.path.join(telemetry_dir, "metrics.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(out, f, indent=1, default=str)
     return out

@@ -41,6 +41,11 @@ import jax
 import jax.numpy as jnp
 
 from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.telemetry import (
+    FlightRecorder,
+    RetraceWatchdog,
+    SpanTracer,
+)
 from mmlspark_tpu.models.generate import _cached_apply, init_cache
 from mmlspark_tpu.serve.cache_pool import SlotCachePool
 from mmlspark_tpu.serve.metrics import ServeMetrics
@@ -49,13 +54,14 @@ from mmlspark_tpu.serve.scheduler import (
     RequestResult,
     ServeRequest,
 )
+from mmlspark_tpu.testing.compile_guard import jit_cache_size
 from mmlspark_tpu.utils.profiling import annotate
 
 
 class ServeEngine:
     def __init__(self, graph, variables, *, slots: int = 4,
                  cache_len: int | None = None, max_queue: int = 16,
-                 pad_id: int = 0):
+                 pad_id: int = 0, recorder: FlightRecorder | None = None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -94,6 +100,13 @@ class ServeEngine:
         self.cache_len = cache_len
         self.pool = SlotCachePool(graph, variables, slots, cache_len)
         self.metrics = ServeMetrics(graph.name, slots)
+        #: flight recorder (core/telemetry): one span per request
+        #: lifecycle — queued -> admitted -> prefill[bucket] -> decode
+        #: ticks -> finished/expired — dumpable as events.jsonl via the
+        #: CLI's ``--telemetry-dir`` (docs/OBSERVABILITY.md)
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        self._tracer = SpanTracer(self.recorder)
+        self._spans: dict[int, object] = {}
         self._sched = ContinuousBatchScheduler(self.pool,
                                                max_queue=max_queue)
         self._next_id = 0
@@ -135,13 +148,24 @@ class ServeEngine:
             nxt = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1)
             return nxt.astype(jnp.int32), buffers
 
-        self._prefill = jax.jit(_prefill)
+        # both programs run behind the retrace watchdog: any compile
+        # beyond the design's budget (decode: 1, prefill: one per
+        # bucket) is logged the moment it happens with the abstract
+        # shapes that triggered it, and lands in the flight recorder's
+        # event timeline next to the request that caused it
+        self._prefill = RetraceWatchdog(
+            jax.jit(_prefill), "serve.prefill",
+            registry=self.metrics.registry, recorder=self.recorder,
+        )
         # the slot-pool cache pytree is DONATED through the decode step:
         # K/V buffers update in place on device instead of being copied
         # each tick. Contract: the engine immediately rebinds
         # ``pool.buffers`` to the step's outputs and nothing else may
         # hold the donated references (docs/SERVING.md).
-        self._decode = jax.jit(_decode, donate_argnums=(1,))
+        self._decode = RetraceWatchdog(
+            jax.jit(_decode, donate_argnums=(1,)), "serve.decode",
+            registry=self.metrics.registry, recorder=self.recorder,
+        )
 
     # -- prefill buckets ---------------------------------------------------
 
@@ -184,17 +208,16 @@ class ServeEngine:
     def decode_compile_count(self) -> int:
         """How many programs the fused decode step has compiled — the
         continuous-batching invariant says this stays 1 for the life of
-        the engine (asserted in tests)."""
-        cache_size = getattr(self._decode, "_cache_size", None)
-        return cache_size() if callable(cache_size) else -1
+        the engine (asserted in tests; the retrace watchdog logs any
+        violation live with the triggering shapes)."""
+        return jit_cache_size(self._decode)
 
     @property
     def prefill_compile_count(self) -> int:
         """How many prefill programs have compiled — bounded by
         ``num_prefill_buckets`` for the life of the engine (asserted in
         tests), however many distinct prompt lengths arrive."""
-        cache_size = getattr(self._prefill, "_cache_size", None)
-        return cache_size() if callable(cache_size) else -1
+        return jit_cache_size(self._prefill)
 
     # -- public API --------------------------------------------------------
 
@@ -248,9 +271,19 @@ class ServeEngine:
             self._sched.enqueue(req)
         except FriendlyError:
             self.metrics.record_reject()
+            self.recorder.record(
+                "rejected", tick=self.tick, prompt_len=int(prompt.size),
+                reason="queue_full",
+            )
             raise
         self._next_id += 1
         self.metrics.record_submit()
+        span = self._tracer.span(
+            "request", tick=self.tick, id=req.id,
+            prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
+        )
+        span.event("queued", tick=self.tick, queue_depth=self.queue_depth)
+        self._spans[req.id] = span
         return req.id
 
     def step(self) -> list[RequestResult]:
@@ -266,11 +299,15 @@ class ServeEngine:
             while self._sched.queue_depth and self.pool.free_count:
                 req = self._sched.pop_next()
                 slot = self.pool.lease()
+                span = self._spans.get(req.id)
+                if span is not None:
+                    span.event("admitted", tick=tick, slot=slot)
                 with annotate("serve.prefill"):
                     p = len(req.prompt)
                     bucket = self.prefill_bucket(p)
                     padded = np.full((bucket,), self.pad_id, np.int32)
                     padded[:p] = req.prompt
+                    tp = time.perf_counter()
                     first, cache = self._prefill(
                         self.variables, jnp.asarray(padded[None]), p - 1
                     )
@@ -278,6 +315,11 @@ class ServeEngine:
                     # tail of the bucket cache is dropped here
                     self.pool.write_prefill(slot, cache, p)
                     first = int(first[0])
+                if span is not None:
+                    span.event(
+                        "prefill", tick=tick, bucket=bucket,
+                        ms=round((time.perf_counter() - tp) * 1e3, 3),
+                    )
                 self.metrics.record_first_token(req, tick, bucket=bucket)
                 done = self._sched.activate(slot, req, first, tick)
                 if done is not None:
@@ -302,10 +344,17 @@ class ServeEngine:
                 # outputs before anything can touch the stale references
                 self.pool.buffers = buffers
                 nxt = np.asarray(nxt)  # host sync: (S,) int32 only
+                decode_s = time.perf_counter() - td
                 self.metrics.record_decode(
-                    n_active, time.perf_counter() - td,
+                    n_active, decode_s,
                     live_kv=live_kv, cache_len=self.cache_len,
                 )
+            decode_ms = round(decode_s * 1e3, 3)
+            for st in self._sched.active.values():
+                span = self._spans.get(st.req.id)
+                if span is not None:
+                    span.event("decode", tick=tick, pos=st.pos,
+                               n_active=n_active, step_ms=decode_ms)
             finished.extend(self._sched.consume(nxt, tick))
 
         self._sched.tick_count += 1
@@ -315,6 +364,10 @@ class ServeEngine:
         )
         for res in finished:
             self.metrics.record_finish(res)
+            span = self._spans.pop(res.id, None)
+            if span is not None:
+                span.end(res.status, tick=res.finish_tick,
+                         generated=res.generated)
         return finished
 
     def run(self, max_ticks: int = 100_000) -> dict[int, RequestResult]:
@@ -324,13 +377,17 @@ class ServeEngine:
         bound means a caller bug — reported as the typed error)."""
         results: dict[int, RequestResult] = {}
         start = self.tick
-        while self._sched.busy:
-            if self.tick - start >= max_ticks:
-                raise FriendlyError(
-                    f"serve run() exceeded max_ticks ({max_ticks}) with "
-                    f"{self._sched.queue_depth} queued and "
-                    f"{len(self._sched.active)} active requests"
-                )
-            for res in self.step():
-                results[res.id] = res
+        # black-box contract: the flight recorder dumps its last N
+        # events to the error log automatically when the typed error
+        # escapes — the post-mortem for "what was the engine doing"
+        with self.recorder.dump_on_friendly_error():
+            while self._sched.busy:
+                if self.tick - start >= max_ticks:
+                    raise FriendlyError(
+                        f"serve run() exceeded max_ticks ({max_ticks}) "
+                        f"with {self._sched.queue_depth} queued and "
+                        f"{len(self._sched.active)} active requests"
+                    )
+                for res in self.step():
+                    results[res.id] = res
         return results

@@ -1,11 +1,19 @@
 """Serving observability: queue depth, time-to-first-token, per-token
 latency, slot utilization, throughput.
 
+Built on the shared telemetry plane
+(:mod:`mmlspark_tpu.core.telemetry`): counts are registry ``Counter``s
+and the latency figures feed log-bucketed ``Histogram``s, so
+``to_dict()`` carries exact means AND deterministic p50/p95/p99
+percentiles for TTFT, per-token decode latency, and tick duration.
 Surfaced two ways, matching the framework's metric UX
 (:mod:`mmlspark_tpu.core.metrics_contracts`): ``snapshot()`` returns
-structured :class:`MetricData` records (group ``"serve"``) for logging,
+structured :class:`MetricData` records (scalars in group ``"serve"``,
+non-scalar metrics like ``prefill_buckets`` as ``create_table`` rows),
 and ``to_dict()`` returns the flat JSON-able dict the ``serve``
-subcommand and ``bench.py``'s ``serve`` metric group emit as one line.
+subcommand and ``bench.py``'s ``serve`` metric group emit as one line —
+and that ``--telemetry-dir`` persists as ``metrics.json``
+(docs/OBSERVABILITY.md).
 
 Tick-count figures (TTFT in ticks, queue depth) are DETERMINISTIC given
 the arrival schedule — the unit tests assert on them; wall-clock figures
@@ -18,6 +26,7 @@ from __future__ import annotations
 import time
 
 from mmlspark_tpu.core.metrics_contracts import MetricData
+from mmlspark_tpu.core.telemetry import MetricRegistry
 
 
 def _mean(xs) -> float | None:
@@ -25,16 +34,26 @@ def _mean(xs) -> float | None:
     return (sum(xs) / len(xs)) if xs else None
 
 
+def _rnd(value: float | None, digits: int = 3) -> float | None:
+    return round(value, digits) if value is not None else None
+
+
 class ServeMetrics:
-    def __init__(self, model: str, slots: int):
+    def __init__(self, model: str, slots: int,
+                 registry: MetricRegistry | None = None):
         self.model = model
         self.slots = slots
-        self.submitted = 0
-        self.rejected = 0
-        self.completed = 0
-        self.expired = 0
-        self.tokens_generated = 0
-        self.prefills = 0
+        self.registry = registry if registry is not None else MetricRegistry()
+        r = self.registry
+        self._submitted = r.counter("serve.submitted")
+        self._rejected = r.counter("serve.rejected")
+        self._completed = r.counter("serve.completed")
+        self._expired = r.counter("serve.expired")
+        self._tokens_generated = r.counter("serve.tokens_generated")
+        self._prefills = r.counter("serve.prefills")
+        self._ttft_ms = r.histogram("serve.ttft_ms")
+        self._per_token_ms = r.histogram("serve.per_token_ms")
+        self._tick_ms = r.histogram("serve.tick_ms")
         self.queue_depth_samples: list[int] = []
         self.util_samples: list[float] = []
         self.tick_seconds: list[float] = []
@@ -53,6 +72,32 @@ class ServeMetrics:
         self._t0: float | None = None
         self._t_last: float | None = None
 
+    # -- registry-backed counts (the attribute API tests assert on) --------
+
+    @property
+    def submitted(self) -> int:
+        return self._submitted.value
+
+    @property
+    def rejected(self) -> int:
+        return self._rejected.value
+
+    @property
+    def completed(self) -> int:
+        return self._completed.value
+
+    @property
+    def expired(self) -> int:
+        return self._expired.value
+
+    @property
+    def tokens_generated(self) -> int:
+        return self._tokens_generated.value
+
+    @property
+    def prefills(self) -> int:
+        return self._prefills.value
+
     # -- recording hooks (called by the engine) ---------------------------
 
     def _touch(self) -> None:
@@ -62,17 +107,22 @@ class ServeMetrics:
         self._t_last = now
 
     def record_submit(self) -> None:
-        self.submitted += 1
+        self._submitted.inc()
         self._touch()
 
     def record_reject(self) -> None:
-        self.rejected += 1
+        self._rejected.inc()
+        # a run that ends in rejections still happened: without the
+        # touch, wall_s (and tokens/sec's denominator) would exclude it
+        self._touch()
 
     def record_first_token(self, req, tick: int,
                            bucket: int | None = None) -> None:
-        self.prefills += 1
+        self._prefills.inc()
         self.ttft_ticks.append(tick - req.submit_tick)
-        self.ttft_s.append(time.perf_counter() - req.submit_wall)
+        ttft = time.perf_counter() - req.submit_wall
+        self.ttft_s.append(ttft)
+        self._ttft_ms.record(ttft * 1e3)
         if bucket is not None:
             key = str(bucket)
             self.prefill_buckets[key] = self.prefill_buckets.get(key, 0) + 1
@@ -82,16 +132,18 @@ class ServeMetrics:
                       cache_len: int | None = None) -> None:
         self.decode_seconds += seconds
         self.decode_tokens += n_active
+        if n_active:
+            self._per_token_ms.record(seconds / n_active * 1e3)
         if live_kv is not None and cache_len is not None:
             self.decode_live_kv += live_kv
             self.decode_dense_kv += n_active * cache_len
 
     def record_finish(self, result) -> None:
         if result.status == "expired":
-            self.expired += 1
+            self._expired.inc()
         else:
-            self.completed += 1
-        self.tokens_generated += result.generated
+            self._completed.inc()
+        self._tokens_generated.inc(result.generated)
         self._touch()
 
     def sample_tick(self, queue_depth: int, leased: int,
@@ -99,6 +151,7 @@ class ServeMetrics:
         self.queue_depth_samples.append(queue_depth)
         self.util_samples.append(leased / self.slots)
         self.tick_seconds.append(seconds)
+        self._tick_ms.record(seconds * 1e3)
         self._touch()
 
     # -- views -------------------------------------------------------------
@@ -132,9 +185,18 @@ class ServeMetrics:
             "ttft_ms_mean": (
                 round(_mean(self.ttft_s) * 1e3, 3) if self.ttft_s else None
             ),
+            "ttft_ms_p50": _rnd(self._ttft_ms.percentile(50)),
+            "ttft_ms_p95": _rnd(self._ttft_ms.percentile(95)),
+            "ttft_ms_p99": _rnd(self._ttft_ms.percentile(99)),
             "per_token_ms": (
                 round(per_tok * 1e3, 4) if per_tok is not None else None
             ),
+            "per_token_ms_p50": _rnd(self._per_token_ms.percentile(50), 4),
+            "per_token_ms_p95": _rnd(self._per_token_ms.percentile(95), 4),
+            "per_token_ms_p99": _rnd(self._per_token_ms.percentile(99), 4),
+            "tick_ms_p50": _rnd(self._tick_ms.percentile(50)),
+            "tick_ms_p95": _rnd(self._tick_ms.percentile(95)),
+            "tick_ms_p99": _rnd(self._tick_ms.percentile(99)),
             "slot_utilization_mean": (
                 round(_mean(self.util_samples), 4)
                 if self.util_samples else None
@@ -162,15 +224,22 @@ class ServeMetrics:
         }
 
     def snapshot(self) -> list[MetricData]:
-        """Structured records for the logging/metrics plane; one
-        MetricData per scalar, group ``"serve"``."""
+        """Structured records for the logging/metrics plane: one
+        MetricData per scalar (group ``"serve"``) and one
+        ``create_table`` record per non-scalar metric — the
+        ``prefill_buckets`` dict reaches the metrics plane instead of
+        being silently dropped."""
         out = []
         for name, value in self.to_dict().items():
-            if isinstance(value, (int, float)) and not isinstance(
-                value, bool
-            ):
+            if isinstance(value, bool):
+                continue
+            if isinstance(value, (int, float)):
                 out.append(MetricData(
                     name=f"serve.{name}", value=float(value),
                     model=self.model, group="serve",
+                ))
+            elif isinstance(value, dict):
+                out.append(MetricData.create_table(
+                    f"serve.{name}", dict(value), self.model,
                 ))
         return out

@@ -5,6 +5,6 @@ datagen,fuzzing} sbt projects — SURVEY.md §2/L9).
 the serving engine's compile-once invariants live there.
 """
 
-from mmlspark_tpu.testing.compile_guard import compile_guard
+from mmlspark_tpu.testing.compile_guard import compile_guard, jit_cache_size
 
-__all__ = ["compile_guard"]
+__all__ = ["compile_guard", "jit_cache_size"]

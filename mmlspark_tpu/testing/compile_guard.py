@@ -25,6 +25,16 @@ from contextlib import contextmanager
 from typing import Callable, Iterator
 
 
+def jit_cache_size(fn) -> int:
+    """Compiled-program count of a jitted callable, -1 when the object
+    exposes no ``_cache_size`` (not jitted, or a future jax renamed
+    it). ONE definition of the counting contract: ``compile_guard``
+    callers, ``ServeEngine``'s compile-count properties, and the
+    telemetry plane's ``RetraceWatchdog`` all read through it."""
+    cache_size = getattr(fn, "_cache_size", None)
+    return cache_size() if callable(cache_size) else -1
+
+
 @contextmanager
 def compile_guard(count_fn: Callable[[], int], *, max_programs: int,
                   min_programs: int = 0,

@@ -20,6 +20,7 @@ TPU-native replacement, per BASELINE.json's north star:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
@@ -27,6 +28,7 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError, ParamError
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.telemetry import MetricRegistry
 from mmlspark_tpu.models.graph import NamedGraph
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, batch_spec, make_mesh, replicated_spec
 
@@ -183,10 +185,17 @@ class SPMDTrainer:
     reference's single external training run, minus the process boundary.
     """
 
-    def __init__(self, graph: NamedGraph, config: TrainConfig):
+    def __init__(self, graph: NamedGraph, config: TrainConfig,
+                 telemetry: MetricRegistry | None = None):
         self.graph = graph
         self.config = config
         self.history: list[dict] = []
+        #: per-trainer metric registry (core/telemetry): step-time,
+        #: tokens/sec, loss, and grad-norm histograms, recorded at
+        #: ``log_every`` cadence — ``telemetry.to_dict()`` is the flat
+        #: percentile view (docs/OBSERVABILITY.md)
+        self.telemetry = telemetry if telemetry is not None \
+            else MetricRegistry()
 
     # -- checkpointing ------------------------------------------------------
 
@@ -343,9 +352,13 @@ class SPMDTrainer:
                     lambda t: t / denom, gsum
                 )
                 loss = lsum / denom
+            # global grad norm BEFORE the optimizer transform: the
+            # scale-blowup/vanishing signal the telemetry histograms
+            # track — one extra scalar through the existing fetch
+            gnorm = optax.global_norm(grads)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return new_params, new_rest, new_opt, loss
+            return new_params, new_rest, new_opt, loss, gnorm
 
         if cfg.param_rules:
             # tensor parallelism: shard params per rule set; optimizer
@@ -383,7 +396,7 @@ class SPMDTrainer:
                 in_shardings=(
                     rep_sh, rep_sh, rep_sh, data_sh, data_sh, data_sh,
                 ),
-                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh),
+                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh, rep_sh),
                 donate_argnums=(0, 1, 2),
             )
 
@@ -401,13 +414,13 @@ class SPMDTrainer:
             def chunk_fn(params, rest, opt_state, bxs, bys, bms):
                 def body(carry, xs):
                     p, r, o = carry
-                    p, r, o, loss = step_fn(p, r, o, *xs)
-                    return (p, r, o), loss
+                    p, r, o, loss, gnorm = step_fn(p, r, o, *xs)
+                    return (p, r, o), (loss, gnorm)
 
-                (params, rest, opt_state), losses = jax.lax.scan(
+                (params, rest, opt_state), (losses, gnorms) = jax.lax.scan(
                     body, (params, rest, opt_state), (bxs, bys, bms)
                 )
-                return params, rest, opt_state, losses[-1]
+                return params, rest, opt_state, losses[-1], gnorms[-1]
 
             # batch dim is axis 1 of the (K, batch, ...) stacks
             chunk_sh = NamedSharding(mesh, P(None, DATA_AXIS))
@@ -416,7 +429,7 @@ class SPMDTrainer:
                 in_shardings=(
                     rep_sh, rep_sh, rep_sh, chunk_sh, chunk_sh, chunk_sh,
                 ),
-                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh),
+                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh, rep_sh),
                 donate_argnums=(0, 1, 2),
             )
 
@@ -452,7 +465,14 @@ class SPMDTrainer:
                     yield buf  # epoch tail; runs through the 1-step path
 
             log_every = max(cfg.log_every, 1)
+            # telemetry's tokens/sec figure: rows x sequence length for
+            # token-sequence inputs (2-D integer batches), plain rows
+            # otherwise — the throughput unit scaling work cares about
+            tokens_per_step = batch * (
+                x.shape[1] if np.ndim(x) == 2 else 1
+            )
             for group in grouped(it):
+                t_group = time.perf_counter()
                 if k_steps > 1 and len(group) == k_steps:
                     stacks = (
                         jax.device_put(
@@ -461,7 +481,7 @@ class SPMDTrainer:
                         )
                         for c in ("x", "y", MASK_COL)
                     )
-                    params, rest, opt_state, loss = chunk_jitted(
+                    params, rest, opt_state, loss, gnorm = chunk_jitted(
                         params, rest, opt_state, *stacks
                     )
                     n_done = len(group)
@@ -472,7 +492,7 @@ class SPMDTrainer:
                         bm = jax.device_put(
                             jnp.asarray(b[MASK_COL]), data_sh
                         )
-                        params, rest, opt_state, loss = jitted(
+                        params, rest, opt_state, loss, gnorm = jitted(
                             params, rest, opt_state, bx, by, bm
                         )
                     n_done = len(group)
@@ -483,11 +503,29 @@ class SPMDTrainer:
                 step += n_done
                 if next_log < step:
                     loss_val = float(loss)
-                    self.history.append(
-                        {"step": step - 1, "epoch": epoch, "loss": loss_val}
+                    gnorm_val = float(gnorm)
+                    # the group's dispatch+device wall, amortized per
+                    # step — async dispatch means the host-side fetch of
+                    # ``loss`` above is what synchronizes the clock
+                    step_s = max(
+                        (time.perf_counter() - t_group) / n_done, 1e-9
                     )
-                    _log.info("step %d epoch %d loss %.5f", step - 1, epoch,
-                              loss_val)
+                    tel = self.telemetry
+                    tel.histogram("train.step_ms").record(step_s * 1e3)
+                    tel.histogram("train.tokens_per_sec").record(
+                        tokens_per_step / step_s
+                    )
+                    tel.histogram("train.loss").record(loss_val)
+                    tel.histogram("train.grad_norm").record(gnorm_val)
+                    self.history.append(
+                        {"step": step - 1, "epoch": epoch, "loss": loss_val,
+                         "grad_norm": gnorm_val}
+                    )
+                    _log.info(
+                        "step %d epoch %d loss %.5f grad_norm %.4f "
+                        "step_ms %.1f", step - 1, epoch, loss_val,
+                        gnorm_val, step_s * 1e3,
+                    )
                 if (
                     mngr is not None
                     and cfg.checkpoint_every

@@ -1,4 +1,5 @@
-"""Profiling hooks: jax.profiler traces around pipeline work.
+"""Profiling hooks: jax.profiler traces around pipeline work, plus the
+unified telemetry plane's public names.
 
 The reference's only tracing is the Timer stage's wall-clock logging
 (pipeline-stages/src/main/scala/Timer.scala:14-123) — no sampling profiler
@@ -6,6 +7,12 @@ exists (SURVEY.md §5). The TPU build keeps Timer and adds the natural
 upgrade the survey calls for: XLA-level traces via ``jax.profiler``,
 viewable in TensorBoard/Perfetto, capturing compilation, device compute,
 and host↔device transfers.
+
+The structured side — metric registry with latency histograms, trace
+spans, the flight recorder, and the retrace watchdog — lives in
+:mod:`mmlspark_tpu.core.telemetry` (docs/OBSERVABILITY.md) and is
+re-exported here so call sites have ONE observability import next to
+the jax.profiler hooks.
 """
 
 from __future__ import annotations
@@ -14,6 +21,18 @@ import contextlib
 import os
 
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.telemetry import (  # noqa: F401 — re-exports
+    Counter,
+    FlightRecorder,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    RetraceWatchdog,
+    Span,
+    SpanTracer,
+    default_registry,
+    watch_retrace,
+)
 
 _log = get_logger("profiling")
 

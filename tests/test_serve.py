@@ -234,9 +234,13 @@ def test_metrics_dict_and_snapshot():
 
     records = engine.metrics.snapshot()
     assert records and all(isinstance(r, MetricData) for r in records)
-    assert all(r.group == "serve" for r in records)
+    assert all(r.group in ("serve", "table") for r in records)
     names = {r.name for r in records}
     assert "serve.completed" in names and "serve.per_token_ms" in names
+    # non-scalar metrics must NOT be dropped: prefill_buckets reaches the
+    # metrics plane as a create_table record
+    tables = [r for r in records if r.group == "table"]
+    assert any(r.name == "serve.prefill_buckets" for r in tables)
 
 
 # -- compile-count invariants (bucketed prefill + fused decode) -------------

@@ -1,0 +1,227 @@
+"""Unified telemetry plane (core/telemetry): deterministic histogram
+quantiles, the flight recorder's ring-buffer + dump-on-error contract,
+span lifecycles, and the retrace watchdog — plus the serve wiring
+(``--telemetry-dir`` artifacts, ``record_reject`` wall-clock fix,
+``snapshot()`` table records)."""
+
+import json
+import logging
+import random
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.telemetry import (
+    FlightRecorder,
+    Histogram,
+    MetricRegistry,
+    RetraceWatchdog,
+    SpanTracer,
+)
+from mmlspark_tpu.serve.metrics import ServeMetrics
+
+# -- histogram primitives ---------------------------------------------------
+
+
+def test_histogram_percentiles_are_order_independent():
+    """Same samples in ANY arrival order -> byte-identical summaries;
+    that determinism is the whole point of log-bucketed bins."""
+    rng = random.Random(7)
+    samples = [rng.lognormvariate(2.0, 1.5) for _ in range(500)]
+    summaries = []
+    for _ in range(3):
+        rng.shuffle(samples)
+        h = Histogram("t")
+        for v in samples:
+            h.record(v)
+        summaries.append(h.summary())
+    assert summaries[0] == summaries[1] == summaries[2]
+
+
+def test_histogram_relative_error_bounded_by_growth():
+    rng = random.Random(3)
+    samples = [rng.uniform(0.5, 400.0) for _ in range(2000)]
+    h = Histogram("t", growth=1.1)
+    for v in samples:
+        h.record(v)
+    for p in (50, 95, 99):
+        exact = float(np.percentile(samples, p))
+        est = h.percentile(p)
+        assert abs(est - exact) / exact < 0.12, (p, est, exact)
+    # count/sum/min/max are exact, not bucketed
+    assert h.count == len(samples)
+    assert h.min == min(samples) and h.max == max(samples)
+    assert h.sum == pytest.approx(sum(samples))
+
+
+def test_histogram_edges_and_empty():
+    h = Histogram("t")
+    assert h.percentile(50) is None and h.mean is None
+    h.record(0.0)  # underflow bucket: values <= lo
+    assert h.percentile(50) == 0.0  # clamped into exact [min, max]
+    h2 = Histogram("t2")
+    h2.record(1e12)  # overflow bucket: clamped to exact max
+    assert h2.percentile(99) == 1e12
+    with pytest.raises(FriendlyError):
+        Histogram("bad", lo=0.0)
+    with pytest.raises(FriendlyError):
+        Histogram("bad", growth=1.0)
+
+
+def test_registry_get_or_create_and_type_conflict():
+    r = MetricRegistry()
+    c = r.counter("a")
+    c.inc(3)
+    assert r.counter("a") is c and r.counter("a").value == 3
+    r.gauge("g").set(2.5)
+    r.histogram("h").record(10.0)
+    with pytest.raises(FriendlyError, match="already registered"):
+        r.histogram("a")
+    d = r.to_dict()
+    assert d["a"] == 3 and d["g"] == 2.5
+    # histograms expand to <name>_{count,mean,p50,p95,p99}
+    assert d["h_count"] == 1 and d["h_p50"] == 10.0
+    json.dumps(d)
+    names = {m.name for m in r.snapshot(model="m", group="test")}
+    assert names == {"a", "g", "h"}
+
+
+# -- flight recorder + spans ------------------------------------------------
+
+
+def test_flight_recorder_ring_keeps_last_n():
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("ev", tick=i)
+    evs = rec.events()
+    assert len(evs) == 8
+    assert [e["tick"] for e in evs] == list(range(12, 20))
+    assert rec.dropped == 12
+    lines = rec.dump().strip().splitlines()
+    assert len(lines) == 8 and json.loads(lines[0])["tick"] == 12
+
+
+def test_flight_recorder_dumps_on_friendly_error(tmp_path):
+    rec = FlightRecorder()
+    rec.record("before", tick=1, detail="context")
+    path = tmp_path / "crash.jsonl"
+    with pytest.raises(FriendlyError, match="boom"):
+        with rec.dump_on_friendly_error(str(path)):
+            rec.record("during", tick=2)
+            raise FriendlyError("boom")
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [e["name"] for e in lines] == ["before", "during"]
+    # non-matching exceptions pass through without a dump
+    with pytest.raises(ValueError):
+        with rec.dump_on_friendly_error(str(tmp_path / "no.jsonl")):
+            raise ValueError("not friendly")
+    assert not (tmp_path / "no.jsonl").exists()
+
+
+def test_span_lifecycle_and_idempotent_end():
+    rec = FlightRecorder()
+    tracer = SpanTracer(rec)
+    s = tracer.span("request", tick=0, id=7)
+    s.event("queued", tick=0, queue_depth=1)
+    s.end("completed", tick=3, generated=4)
+    s.end("completed", tick=9)  # second end is a no-op
+    evs = rec.events()
+    assert [e["name"] for e in evs] == ["start", "queued", "completed"]
+    assert all(e["span"] == s.id and e["span_name"] == "request"
+               for e in evs)
+    assert evs[-1]["attrs"]["duration_ms"] >= 0.0
+    assert tracer.span("request").id != s.id  # process-unique ids
+
+
+# -- retrace watchdog -------------------------------------------------------
+
+
+def test_retrace_watchdog_fires_once_per_new_shape(caplog):
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricRegistry()
+    rec = FlightRecorder()
+    fn = jax.jit(lambda x: jnp.sum(x * 2))
+    dog = RetraceWatchdog(fn, "unit", registry=reg, recorder=rec)
+
+    with caplog.at_level(logging.INFO, logger="mmlspark_tpu.telemetry"):
+        dog(jnp.zeros((4,), jnp.float32))   # first program: INFO
+        assert dog.compilations == 1 and dog.retraces == 0
+        dog(jnp.ones((4,), jnp.float32))    # cache hit: silent
+        assert dog.compilations == 1
+        dog(jnp.zeros((8,), jnp.float32))   # NEW shape: the retrace
+    assert dog.compilations == 2 and dog.retraces == 1
+    warnings = [r for r in caplog.records
+                if r.levelno == logging.WARNING and "retrace" in r.message]
+    assert len(warnings) == 1
+    assert "float32[8]" in warnings[0].message  # triggering signature
+    assert reg.counter("retrace.unit").value == 2
+    retrace_evs = [e for e in rec.events() if e["name"] == "retrace"]
+    assert len(retrace_evs) == 2
+    assert "float32[8]" in retrace_evs[-1]["attrs"]["signature"]
+    # compile_guard's counting contract passes through the wrapper
+    assert dog._cache_size() == 2
+
+
+# -- serve wiring -----------------------------------------------------------
+
+
+def test_record_reject_counts_toward_wall_clock():
+    """A run that ends in rejections still happened: wall_s (tokens/sec's
+    denominator) must span reject-only activity."""
+    m = ServeMetrics(model="m", slots=2)
+    m.record_reject()
+    time.sleep(0.01)
+    m.record_reject()
+    d = m.to_dict()
+    assert d["rejected"] == 2
+    assert d["wall_s"] > 0.0
+
+
+def test_snapshot_emits_non_scalar_metrics_as_tables():
+    m = ServeMetrics(model="m", slots=2)
+    m.prefill_buckets = {"8": 3, "16": 1}
+    records = m.snapshot()
+    tables = {r.name: r for r in records if r.group == "table"}
+    assert "serve.prefill_buckets" in tables
+    assert tables["serve.prefill_buckets"].value == {"8": 3, "16": 1}
+
+
+def test_demo_writes_complete_spans_and_percentiles(tmp_path):
+    """The acceptance path: ``serve --demo --telemetry-dir`` persists one
+    COMPLETE span per request in events.jsonl and percentile keys in
+    metrics.json (in-process here; tools/check_metrics_schema.py runs
+    the same contract through the real CLI)."""
+    from mmlspark_tpu.serve.demo import run_demo
+
+    n_requests = 3
+    out = run_demo(slots=2, n_requests=n_requests, max_new_tokens=3,
+                   arrivals_per_tick=2, vocab=32, d_model=16, heads=2,
+                   depth=1, cache_len=32, seed=0,
+                   telemetry_dir=str(tmp_path))
+
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    spans = {}
+    for e in events:
+        if e.get("span_name") == "request":
+            spans.setdefault(e["span"], []).append(e["name"])
+    assert len(spans) == n_requests
+    for names in spans.values():
+        # full lifecycle: queued -> admitted -> prefill[bucket] ->
+        # decode ticks -> terminal status with duration
+        assert names[0] == "start"
+        assert {"queued", "admitted", "prefill"} <= set(names)
+        assert names[-1] in ("completed", "expired")
+    # the watchdog's warm-up compilations ride the same timeline
+    assert any(e["name"] == "retrace" for e in events)
+
+    metrics = json.loads((tmp_path / "metrics.json").read_text())
+    for key in ("ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+                "per_token_ms_p50", "per_token_ms_p95", "per_token_ms_p99",
+                "tick_ms_p50", "tick_ms_p95", "tick_ms_p99"):
+        assert isinstance(metrics[key], (int, float)), key
+    assert metrics == json.loads(json.dumps(out, default=str))

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Telemetry schema gate: run the real ``serve --demo`` CLI with
+``--telemetry-dir`` and assert every emitted artifact keeps its contract.
+
+Three surfaces, all produced by ONE subprocess run at smoke scale:
+
+- stdout: exactly one JSON line (the CLI's parseable-output contract),
+  carrying every historical ``ServeMetrics.to_dict()`` key plus the
+  telemetry plane's percentile keys with the right types;
+- ``metrics.json``: the same dict persisted under ``--telemetry-dir``;
+- ``events.jsonl``: the flight recorder's timeline — every submitted
+  request must appear as one COMPLETE span (start -> queued -> admitted
+  -> prefill -> terminal status).
+
+Exits non-zero with a pointed message on the first violation, so
+``tools/ci.sh`` catches schema drift before a dashboard does
+(docs/OBSERVABILITY.md). Usage::
+
+    python tools/check_metrics_schema.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+N_REQUESTS = 4
+
+# key -> allowed types in the flat metrics dict. ``type(None)`` appears
+# where an empty/degenerate run may legitimately report null; the demo
+# run below always populates them, so None is rejected for those.
+NUM = (int, float)
+REQUIRED_METRIC_KEYS: dict[str, tuple] = {
+    # the pre-telemetry ServeMetrics.to_dict() contract — every key
+    # dashboards already consume must survive
+    "model": (str,),
+    "slots": (int,),
+    "ticks": (int,),
+    "submitted": (int,),
+    "rejected": (int,),
+    "completed": (int,),
+    "expired": (int,),
+    "tokens_generated": (int,),
+    "queue_depth_mean": NUM,
+    "queue_depth_max": NUM,
+    "ttft_ticks_mean": NUM,
+    "ttft_ms_mean": NUM,
+    "per_token_ms": NUM,
+    "slot_utilization_mean": NUM,
+    "slot_utilization_peak": NUM,
+    "tokens_per_sec": NUM,
+    "wall_s": NUM,
+    "decode_live_kv_tokens": (int,),
+    "decode_dense_kv_tokens": (int,),
+    "decode_flop_utilization": NUM,
+    "prefill_buckets": (dict,),
+    # the telemetry plane's additions
+    "ttft_ms_p50": NUM,
+    "ttft_ms_p95": NUM,
+    "ttft_ms_p99": NUM,
+    "per_token_ms_p50": NUM,
+    "per_token_ms_p95": NUM,
+    "per_token_ms_p99": NUM,
+    "tick_ms_p50": NUM,
+    "tick_ms_p95": NUM,
+    "tick_ms_p99": NUM,
+    # demo envelope
+    "n_requests": (int,),
+    "decode_compiles": (int,),
+    "prefill_compiles": (int,),
+    "prefill_bucket_count": (int,),
+}
+
+
+def fail(msg: str) -> "None":
+    print(f"check_metrics_schema: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_metrics_dict(d: dict, source: str) -> None:
+    for key, types in REQUIRED_METRIC_KEYS.items():
+        if key not in d:
+            fail(f"{source}: missing key {key!r}")
+        if not isinstance(d[key], types):
+            fail(
+                f"{source}: key {key!r} has type "
+                f"{type(d[key]).__name__}, expected one of "
+                f"{[t.__name__ for t in types]} (value: {d[key]!r})"
+            )
+
+
+def check_events(path: str, n_requests: int) -> int:
+    try:
+        lines = open(path, encoding="utf-8").read().splitlines()
+    except OSError as e:
+        fail(f"events.jsonl unreadable: {e}")
+    spans: dict[int, list[str]] = {}
+    for i, line in enumerate(lines, 1):
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError as e:
+            fail(f"events.jsonl line {i} is not JSON: {e}")
+        if "t" not in ev or "name" not in ev:
+            fail(f"events.jsonl line {i} lacks 't'/'name': {ev}")
+        if ev.get("span_name") == "request":
+            spans.setdefault(ev["span"], []).append(ev["name"])
+    if len(spans) != n_requests:
+        fail(
+            f"events.jsonl holds {len(spans)} request spans, expected "
+            f"one per submitted request ({n_requests})"
+        )
+    for sid, names in spans.items():
+        if names[0] != "start":
+            fail(f"span {sid} does not open with 'start': {names}")
+        missing = {"queued", "admitted", "prefill"} - set(names)
+        if missing:
+            fail(f"span {sid} lacks lifecycle events {missing}: {names}")
+        if names[-1] not in ("completed", "expired"):
+            fail(f"span {sid} never reached a terminal status: {names}")
+    return len(lines)
+
+
+def main() -> None:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as tdir:
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4",
+            "serve", "--demo", "--slots", "2",
+            "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
+            "--telemetry-dir", tdir,
+        ]
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env=env, cwd=repo,
+        )
+        if res.returncode != 0:
+            fail(f"serve --demo exited {res.returncode}:\n{res.stderr}")
+        out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+        if len(out_lines) != 1:
+            fail(
+                f"stdout must be exactly ONE JSON line, got "
+                f"{len(out_lines)}:\n{res.stdout}"
+            )
+        try:
+            stdout_metrics = json.loads(out_lines[0])
+        except json.JSONDecodeError as e:
+            fail(f"stdout line is not JSON: {e}")
+        check_metrics_dict(stdout_metrics, "stdout")
+
+        mpath = os.path.join(tdir, "metrics.json")
+        if not os.path.exists(mpath):
+            fail("--telemetry-dir did not produce metrics.json")
+        check_metrics_dict(
+            json.load(open(mpath, encoding="utf-8")), "metrics.json"
+        )
+        n_events = check_events(
+            os.path.join(tdir, "events.jsonl"), N_REQUESTS
+        )
+    print(
+        f"check_metrics_schema: OK — {len(REQUIRED_METRIC_KEYS)} metric "
+        f"keys on both surfaces, {N_REQUESTS} complete request spans "
+        f"across {n_events} events"
+    )
+
+
+if __name__ == "__main__":
+    main()

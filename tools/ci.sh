@@ -84,6 +84,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
     rm -rf "$(dirname "$venv_dir")"
   fi
 
+  step "telemetry schema gate (serve --demo artifacts)"
+  python tools/check_metrics_schema.py
+
   step "docgen"
   python tools/docgen.py
 
