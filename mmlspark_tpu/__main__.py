@@ -76,6 +76,7 @@ def cmd_serve(args) -> int:
         max_new_tokens=args.max_new_tokens,
         arrivals_per_tick=args.arrivals_per_tick,
         seed=args.seed,
+        decode_block=args.decode_block,
         telemetry_dir=args.telemetry_dir or None,
     )
     print(json.dumps(metrics, default=str))
@@ -194,6 +195,12 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--max-new-tokens", type=int, default=8)
     sp.add_argument("--arrivals-per-tick", type=int, default=2)
     sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument(
+        "--decode-block", type=int, default=None, metavar="T",
+        help="max fused decode-block size: up to T tokens per dispatch "
+        "and per host sync (power-of-two ladder; default: engine's 32; "
+        "1 = the old per-token stepping)",
+    )
     sp.add_argument(
         "--telemetry-dir", default="", metavar="DIR",
         help="write events.jsonl (per-request trace spans) and "

@@ -399,7 +399,15 @@ def _describe_abstract(args: tuple, kwargs: dict, limit: int = 12) -> str:
         if shape is None:
             parts.append(repr(leaf)[:32])
             continue
-        dtype = getattr(leaf, "dtype", np.asarray(leaf).dtype)
+        # NB: the fallback must stay lazy — np.asarray() as an eager
+        # getattr default would force a device->host sync per leaf on
+        # every watchdog-wrapped dispatch
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            try:
+                dtype = np.asarray(leaf).dtype
+            except Exception:  # noqa: BLE001 — formatting must never raise
+                dtype = "?"
         parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
     if len(leaves) > limit:
         parts.append(f"... +{len(leaves) - limit} leaves")
@@ -413,23 +421,27 @@ class RetraceWatchdog:
     ``testing/compile_guard.py`` pins invariants with
     (:func:`mmlspark_tpu.testing.compile_guard.jit_cache_size`): the
     cache size is sampled after each call, and growth means the call's
-    abstract shapes/dtypes missed the cache — the first program logs at
-    INFO (expected warm-up), every later one at WARNING (a retrace the
-    design probably forbids), both with the triggering signature.
-    Optionally mirrors into a registry counter and a flight-recorder
-    event, so a retrace shows up in the same ``events.jsonl`` timeline
-    as the request that caused it.
+    abstract shapes/dtypes missed the cache — programs within the
+    ``expected_programs`` budget log at INFO (expected warm-up: 1 for a
+    truly-fused step, the ladder/bucket count for a program family like
+    the serve engine's fused decode blocks), every later one at WARNING
+    (a retrace the design probably forbids), all with the triggering
+    signature. Optionally mirrors into a registry counter and a
+    flight-recorder event, so a retrace shows up in the same
+    ``events.jsonl`` timeline as the request that caused it.
     """
 
     def __init__(self, fn: Callable, label: str, *,
                  registry: MetricRegistry | None = None,
-                 recorder: FlightRecorder | None = None):
+                 recorder: FlightRecorder | None = None,
+                 expected_programs: int = 1):
         from mmlspark_tpu.testing.compile_guard import jit_cache_size
 
         self._fn = fn
         self._size_of = jit_cache_size
         self.label = label
         self.compilations = 0  # programs seen by THIS wrapper
+        self.expected_programs = max(1, expected_programs)
         self._counter = (
             registry.counter(f"retrace.{label}")
             if registry is not None else None
@@ -439,8 +451,8 @@ class RetraceWatchdog:
 
     @property
     def retraces(self) -> int:
-        """Compilations beyond the expected first program."""
-        return max(0, self.compilations - 1)
+        """Compilations beyond the expected program budget."""
+        return max(0, self.compilations - self.expected_programs)
 
     def _cache_size(self) -> int:
         """compile_guard-compatible counting passthrough."""
@@ -454,7 +466,11 @@ class RetraceWatchdog:
             self.compilations += new
             self._seen = n
             sig = _describe_abstract(args, kwargs)
-            level = _log.info if self.compilations == new else _log.warning
+            level = (
+                _log.info
+                if self.compilations <= self.expected_programs
+                else _log.warning
+            )
             level(
                 "retrace[%s]: %d new XLA program(s) compiled (total %d) "
                 "for abstract signature (%s)",

@@ -87,7 +87,7 @@ def init_cache(graph, variables, batch: int, total: int) -> dict:
 
 
 def _cached_apply(graph, variables, ids, cache, pos, rolled=False,
-                  step=False):
+                  step=False, live=None):
     """One forward over ``ids`` (B, T) starting at absolute position
     ``pos`` (traced ok), reading/writing the K/V cache. Returns
     (logits (B, T, V), new cache). ``rolled`` switches the blocks to
@@ -95,7 +95,9 @@ def _cached_apply(graph, variables, ids, cache, pos, rolled=False,
     (vs the prefill call) for blocks that route differently there —
     MoE's dropless decode routing. Explicit, not inferred from T: a
     one-token PROMPT is still a prefill and must route with scoring
-    semantics."""
+    semantics. ``live`` ((B,) bool, serving's fused decode blocks only)
+    zeroes dead rows' flash-decode live lengths so the kernel skips
+    their cache reads; only blocks that declare the kwarg receive it."""
     x = ids
     new_cache = dict(cache)
     for name, mod in graph.blocks:
@@ -104,12 +106,85 @@ def _cached_apply(graph, variables, ids, cache, pos, rolled=False,
             kwargs = {"cache": cache[name], "pos": pos, "rolled": rolled}
             if _accepts_kwarg(mod, "decode"):
                 kwargs["decode"] = step
+            if live is not None and _accepts_kwarg(mod, "live"):
+                kwargs["live"] = live
             x, new_cache[name] = mod.apply(v, x, **kwargs)
         elif _accepts_kwarg(mod, "pos"):
             x = mod.apply(v, x, pos=pos)
         else:
             x = mod.apply(v, x)
     return x, new_cache
+
+
+def greedy_next(logits):
+    """The repo-wide greedy pick: argmax over f32-cast logits, returned
+    int32. ONE definition shared by ``generate()``'s temperature-0 path,
+    the serving engine's prefill, and the fused decode block — parity
+    between them is a bit-identity contract, so they must share the
+    tie-breaking and rounding of a single implementation."""
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
+def make_decode_block(graph, pad_id: int = 0):
+    """Build the fused multi-token decode-block program for ``graph``:
+    a ``lax.scan`` over ``t`` greedy micro-steps inside one traceable
+    function. Each micro-step runs the cached forward (flash-decode
+    attention at per-row positions), greedy-samples on device, advances
+    the live rows' positions, and folds EOS/budget into an on-device
+    live mask so finished rows emit ``pad_id`` with no branching. The
+    serving engine jits this with ``t`` static and the (buffers, pos,
+    live) state donated: ONE dispatch and ONE host sync per T tokens
+    (docs/SERVING.md "Decode blocks").
+
+    The returned function's signature::
+
+        decode_block(variables, buffers, pos, live, tok, rem, eos, t)
+
+    - ``buffers``: the slot pool's ``{block: (K, V)}`` cache pytree
+    - ``pos``: (S,) int32 next-write positions (frozen for dead rows,
+      so no scatter ever lands outside a row's leased region)
+    - ``live``: (S,) bool — True while the row has an unfinished tenant
+    - ``tok``: (S,) int32 last emitted token per row
+    - ``rem``: (S,) int32 remaining new-token budget per row
+    - ``eos``: (S,) int32 per-row EOS id, -1 meaning "no EOS"
+    - ``t``: scan length (the block size; static under jit)
+
+    Returns ``(tokens (S, t), live (S,), buffers, pos)`` where the
+    final ``live`` is the per-slot finished vector (False = the row
+    died inside this block). Parity contract: a row's token stream is
+    bit-identical to single-request greedy ``generate()`` up to and
+    including its EOS / last budgeted token; columns after that are
+    pads the host discards.
+    """
+
+    def decode_block(variables, buffers, pos, live, tok, rem, eos, t):
+        def micro(carry, _):
+            tok, buffers, pos, live, rem = carry
+            # write tok's K/V at pos, attend over [0, pos], next logits.
+            # Dead rows run too (fixed shapes) but at frozen pos with
+            # zeroed flash-decode lengths — their only cost is the
+            # repeated, harmless K/V write their next prefill overwrites.
+            logits, buffers = _cached_apply(
+                graph, variables, tok[:, None], buffers, pos,
+                step=True, live=live,
+            )
+            nxt = greedy_next(logits[:, 0])
+            emit = jnp.where(live, nxt, jnp.asarray(pad_id, jnp.int32))
+            pos = jnp.where(live, pos + 1, pos)
+            rem = jnp.where(live, rem - 1, rem)
+            # same semantics as generate()'s ``advance``: the EOS token
+            # IS emitted, THEN the row goes dead; budget death means the
+            # row just emitted its last allowed token
+            live = live & (emit != eos) & (rem > 0)
+            tok = jnp.where(live, emit, tok)
+            return (tok, buffers, pos, live, rem), emit
+
+        (tok, buffers, pos, live, rem), toks = jax.lax.scan(
+            micro, (tok, buffers, pos, live, rem), None, length=t
+        )
+        return jnp.swapaxes(toks, 0, 1), live, buffers, pos
+
+    return decode_block
 
 
 def _roll_prefill_cache(cache, p: int, window: int) -> dict:
@@ -235,7 +310,7 @@ def generate(graph, variables, prompt, max_new_tokens: int, *,
     def pick(cur, rng):
         # cur: (B, V) f32 logits for the next token
         if temperature <= 0.0:
-            return jnp.argmax(cur, axis=-1).astype(jnp.int32), rng
+            return greedy_next(cur), rng
         logits = cur / temperature
         if top_k is not None:
             # kth-highest logit per row is the keep threshold

@@ -93,7 +93,7 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache=None, pos=None, rolled=False,
-                 decode=False):
+                 decode=False, live=None):
         b, t, _ = x.shape
         h, d = self.heads, self.head_dim
         hk = self.kv_heads or h
@@ -190,7 +190,12 @@ class SelfAttention(nn.Module):
                 from mmlspark_tpu.ops.attention import decode_live_lengths
                 from mmlspark_tpu.ops.flash_attention import flash_decode
 
-                o = flash_decode(q, ck, cv, decode_live_lengths(pos, b))
+                # ``live`` (the serve engine's fused decode-block carry)
+                # zeroes dead rows' lengths, so the kernel's early-out
+                # skips their cache traffic mid-block
+                o = flash_decode(
+                    q, ck, cv, decode_live_lengths(pos, b, live=live)
+                )
             else:
                 o = dense_attention(q, ck, cv, causal=True,
                                     window=self.window, q_offset=pos)
@@ -238,13 +243,13 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache=None, pos=None, rolled=False,
-                 decode=False):
+                 decode=False, live=None):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         attn = SelfAttention(
             self.heads, self.head_dim, self.causal, self.attn_impl,
             window=self.window, kv_heads=self.kv_heads, rope=self.rope,
             mesh=self.mesh, dtype=self.dtype, name="attn",
-        )(y, cache=cache, pos=pos, rolled=rolled, decode=decode)
+        )(y, cache=cache, pos=pos, rolled=rolled, decode=decode, live=live)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn

@@ -48,7 +48,7 @@ def mask_value(*, kernel: bool) -> float:
     return KERNEL_NEG_INF if kernel else NEG_INF
 
 
-def decode_live_lengths(pos, batch: int):
+def decode_live_lengths(pos, batch: int, live=None):
     """Per-row LIVE KV lengths for a single-token decode step writing at
     absolute position ``pos``: the step's own K/V lands at ``pos``, so
     positions ``[0, pos]`` are live — length ``pos + 1``.
@@ -60,11 +60,20 @@ def decode_live_lengths(pos, batch: int):
     two paths agree on which cache rows a step may see. ``pos`` is a
     traced scalar or a per-row ``(B,)`` vector (the serving engine's
     multi-tenant step); returns ``(batch,)`` int32.
+
+    ``live`` ((B,) bool, optional — the fused decode BLOCK's carry)
+    zeroes dead rows' lengths: ``flash_decode``'s index-map clamp
+    early-outs at length 0, so a row that finished mid-block stops
+    paying for cache reads entirely (its masked output is a pad either
+    way).
     """
     pos = jnp.asarray(pos, jnp.int32)
     if not pos.ndim:
         pos = jnp.broadcast_to(pos, (batch,))
-    return pos + 1
+    lengths = pos + 1
+    if live is not None:
+        lengths = jnp.where(live, lengths, 0)
+    return lengths
 
 
 def causal_block_mask(q_len: int, kv_len: int, q_offset, kv_offset,

@@ -69,6 +69,17 @@ class SlotCachePool:
         # assignment deterministic for the parity tests
         self._free = list(range(slots - 1, -1, -1))
         self._leased: set[int] = set()
+        # DEVICE-resident per-slot decode state, donated through the
+        # engine's fused decode-block program alongside the K/V buffers
+        # (docs/SERVING.md "Decode blocks"): each slot's next write
+        # position and its live flag (True = active tenant). The scanned
+        # micro-steps advance these ON DEVICE between host syncs; the
+        # scheduler's host bookkeeping mirrors them deterministically.
+        # Free-slot convention: (pos 0, dead) — a dead row runs through
+        # the fixed-shape block masked out, writing only position-0
+        # garbage that the slot's next prefill overwrites.
+        self.positions = jnp.zeros((slots,), jnp.int32)
+        self.live = jnp.zeros((slots,), bool)
 
     # -- accounting --------------------------------------------------------
 
@@ -103,6 +114,11 @@ class SlotCachePool:
             )
         self._leased.remove(slot)
         self._free.append(slot)
+        # restore the free-slot convention (pos 0, dead) so the fused
+        # decode block keeps every write of this row inside the leased
+        # region and its flash-decode length reads as zero
+        self.positions = self.positions.at[slot].set(0)
+        self.live = self.live.at[slot].set(False)
 
     # -- data path ---------------------------------------------------------
 
@@ -124,3 +140,7 @@ class SlotCachePool:
                 pk.at[slot, :length].set(ck[0, :length].astype(pk.dtype)),
                 pv.at[slot, :length].set(cv[0, :length].astype(pv.dtype)),
             )
+        # the slot's first decode step writes its first generated
+        # token's K/V at position ``length`` (the prompt fills [0, P))
+        self.positions = self.positions.at[slot].set(length)
+        self.live = self.live.at[slot].set(True)

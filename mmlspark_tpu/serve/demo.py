@@ -25,6 +25,7 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              vocab: int = 64, d_model: int = 32, heads: int = 2,
              depth: int = 2, cache_len: int = 64, seed: int = 0,
              deadline_ticks: int | None = None,
+             decode_block: int | None = None,
              telemetry_dir: str | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line."""
@@ -44,6 +45,8 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
     engine = ServeEngine(
         graph, variables, slots=slots, cache_len=cache_len,
         max_queue=max(n_requests, 1),
+        # None = the engine's fused decode-block default (32)
+        **({} if decode_block is None else {"decode_block": decode_block}),
     )
 
     rng = np.random.default_rng(seed)

@@ -2,18 +2,28 @@
 
 Turns the repo's static-shape KV-cache decode (``models/generate.py``)
 into a multi-tenant engine: requests of different prompt lengths and
-arrival times share ONE jitted decode step over the slot pool's
-fixed-shape buffers, so XLA compiles the decode program exactly once per
-engine (asserted by ``tests/test_serve.py`` via
-``decode_compile_count``). Prefill is its own jitted program, BUCKETED
-by prompt length: prompts right-pad to power-of-two buckets, so at most
-O(log cache_len) prefill programs ever compile
-(``prefill_compile_count`` <= ``num_prefill_buckets``) — joiners pay a
-bucketed prefill, the steady-state decode tick never recompiles. The
-decode step reads each slot's cache through the length-aware split-KV
-kernel (``ops/flash_attention.flash_decode``) and DONATES the pool's
-buffer pytree, so K/V update in place on device (docs/SERVING.md has
-the donation contract).
+arrival times share ONE jitted decode program over the slot pool's
+fixed-shape buffers. The decode program is a FUSED BLOCK
+(``models.generate.make_decode_block``): ``lax.scan`` over up to
+``decode_block`` greedy micro-steps inside one dispatch, sampling and
+advancing per-slot positions on device, with an on-device live/EOS/
+budget mask so finished slots emit pads without branching — ONE host
+sync per block instead of one per token, which is what the per-token
+latency of a dispatch-bound small-model tick is made of. Block sizes
+are clamped to a power-of-two ladder, so at most
+``num_decode_blocks`` = O(log decode_block) decode programs ever
+compile (asserted by ``tests/test_serve.py`` via
+``decode_compile_count``; the ladder shrinks near per-request budgets
+to keep token-for-token parity with ``generate()``). Prefill is its own
+jitted program, BUCKETED by prompt length: prompts right-pad to
+power-of-two buckets, so at most O(log cache_len) prefill programs ever
+compile (``prefill_compile_count`` <= ``num_prefill_buckets``) —
+joiners pay a bucketed prefill, the steady-state decode tick never
+recompiles. The block reads each slot's cache through the length-aware
+split-KV kernel (``ops/flash_attention.flash_decode``, with dead rows'
+live lengths zeroed mid-block) and DONATES the pool's buffer pytree
+plus the device positions/live mask, so all decode state updates in
+place on device (docs/SERVING.md has the donation contract).
 
 Usage::
 
@@ -46,7 +56,12 @@ from mmlspark_tpu.core.telemetry import (
     RetraceWatchdog,
     SpanTracer,
 )
-from mmlspark_tpu.models.generate import _cached_apply, init_cache
+from mmlspark_tpu.models.generate import (
+    _cached_apply,
+    greedy_next,
+    init_cache,
+    make_decode_block,
+)
 from mmlspark_tpu.serve.cache_pool import SlotCachePool
 from mmlspark_tpu.serve.metrics import ServeMetrics
 from mmlspark_tpu.serve.scheduler import (
@@ -61,7 +76,8 @@ from mmlspark_tpu.utils.profiling import annotate
 class ServeEngine:
     def __init__(self, graph, variables, *, slots: int = 4,
                  cache_len: int | None = None, max_queue: int = 16,
-                 pad_id: int = 0, recorder: FlightRecorder | None = None):
+                 pad_id: int = 0, decode_block: int = 32,
+                 recorder: FlightRecorder | None = None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -94,12 +110,23 @@ class ServeEngine:
                 "buffers are not pooled yet. Serve with cache_len <= "
                 "window, or build the model without window"
             )
+        if decode_block < 1:
+            raise FriendlyError(
+                f"decode_block must be >= 1, got {decode_block} "
+                "(1 = per-token dispatch, larger fuses T micro-steps "
+                "into one device program)"
+            )
         self.graph = graph
         self.variables = variables
         self.pad_id = pad_id
         self.cache_len = cache_len
+        # floor to a power of two: block sizes live on the ladder
+        # {1, 2, 4, ..., decode_block}, so the scan-length static arg
+        # compiles O(log) program variants, never one per budget
+        self.decode_block = 1 << (int(decode_block).bit_length() - 1)
         self.pool = SlotCachePool(graph, variables, slots, cache_len)
-        self.metrics = ServeMetrics(graph.name, slots)
+        self.metrics = ServeMetrics(graph.name, slots,
+                                    decode_block=self.decode_block)
         #: flight recorder (core/telemetry): one span per request
         #: lifecycle — queued -> admitted -> prefill[bucket] -> decode
         #: ticks -> finished/expired — dumpable as events.jsonl via the
@@ -133,38 +160,35 @@ class ServeEngine:
             cur = jax.lax.dynamic_slice_in_dim(
                 logits, last, 1, axis=1
             )[:, 0]
-            first = jnp.argmax(cur.astype(jnp.float32), axis=-1)
-            return first.astype(jnp.int32), cache
-
-        def _decode(variables, buffers, tok, pos):
-            # ONE fused single-token step for every slot: tok/pos are
-            # (S,) and every slot decodes at its own absolute position
-            # (per-row live lengths through ops/flash_attention.py's
-            # flash_decode — work per row scales with its live tokens,
-            # not cache_len). Fixed shapes -> compiled exactly once.
-            logits, buffers = _cached_apply(
-                graph, variables, tok[:, None], buffers, pos, step=True
-            )
-            nxt = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1)
-            return nxt.astype(jnp.int32), buffers
+            return greedy_next(cur), cache
 
         # both programs run behind the retrace watchdog: any compile
-        # beyond the design's budget (decode: 1, prefill: one per
-        # bucket) is logged the moment it happens with the abstract
-        # shapes that triggered it, and lands in the flight recorder's
-        # event timeline next to the request that caused it
+        # beyond the design's budget (decode: one per ladder block
+        # size, prefill: one per bucket) is logged the moment it
+        # happens with the abstract shapes that triggered it, and lands
+        # in the flight recorder's event timeline next to the request
+        # that caused it
         self._prefill = RetraceWatchdog(
             jax.jit(_prefill), "serve.prefill",
             registry=self.metrics.registry, recorder=self.recorder,
+            expected_programs=self.num_prefill_buckets,
         )
-        # the slot-pool cache pytree is DONATED through the decode step:
-        # K/V buffers update in place on device instead of being copied
-        # each tick. Contract: the engine immediately rebinds
-        # ``pool.buffers`` to the step's outputs and nothing else may
+        # the FUSED decode block (models.generate.make_decode_block):
+        # lax.scan over t greedy micro-steps with the scan length
+        # static (one program per ladder size) and the whole device
+        # decode state DONATED — the slot-pool cache pytree AND the
+        # per-slot positions/live mask update in place on device.
+        # Contract: the engine immediately rebinds pool.buffers/
+        # positions/live to the block's outputs and nothing else may
         # hold the donated references (docs/SERVING.md).
         self._decode = RetraceWatchdog(
-            jax.jit(_decode, donate_argnums=(1,)), "serve.decode",
+            jax.jit(
+                make_decode_block(graph, pad_id),
+                static_argnums=(7,), donate_argnums=(1, 2, 3),
+            ),
+            "serve.decode",
             registry=self.metrics.registry, recorder=self.recorder,
+            expected_programs=self.num_decode_blocks,
         )
 
     # -- prefill buckets ---------------------------------------------------
@@ -190,6 +214,30 @@ class ServeEngine:
             self.prefill_bucket(p) for p in range(1, self.cache_len)
         })
 
+    # -- decode-block ladder ----------------------------------------------
+
+    def _block_size(self, min_rem: int) -> int:
+        """This tick's fused-block scan length: the largest ladder power
+        of two <= min(decode_block, minimum remaining budget over active
+        slots). Clamping to the min budget is the "shrink near budgets"
+        parity rule: no slot can overrun its budget mid-block, so budget
+        exhaustion only ever lands exactly on a block boundary (the only
+        mid-block death is EOS, which the on-device mask handles)."""
+        cap = min(self.decode_block, max(1, min_rem))
+        t = 1
+        while t * 2 <= cap:
+            t *= 2
+        return t
+
+    @property
+    def num_decode_blocks(self) -> int:
+        """How many distinct fused decode-block programs CAN exist for
+        this engine — one per ladder size T in {1, 2, 4, ...,
+        decode_block}, the ceiling the compile-guard tests pin decode
+        to. Scan iterations inside a block share one program; only
+        distinct static scan lengths compile separately."""
+        return self.decode_block.bit_length()
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -206,10 +254,12 @@ class ServeEngine:
 
     @property
     def decode_compile_count(self) -> int:
-        """How many programs the fused decode step has compiled — the
-        continuous-batching invariant says this stays 1 for the life of
-        the engine (asserted in tests; the retrace watchdog logs any
-        violation live with the triggering shapes)."""
+        """How many DISTINCT XLA programs the fused decode block has
+        compiled — one per ladder size actually run, never more than
+        ``num_decode_blocks`` for the life of the engine (asserted in
+        tests; the retrace watchdog logs any violation live with the
+        triggering shapes). Scan iterations do NOT count: a T=32 block
+        is one program, not 32."""
         return jit_cache_size(self._decode)
 
     @property
@@ -288,12 +338,16 @@ class ServeEngine:
 
     def step(self) -> list[RequestResult]:
         """One scheduler tick: expire deadlines, admit queued requests
-        into free slots (prefill per joiner), one fused decode step for
-        all active slots, retire finished sequences. Returns the
+        into free slots (prefill per joiner), ONE fused decode block of
+        up to ``decode_block`` tokens for all active slots, retire
+        finished sequences. Admission and retirement happen at block
+        boundaries; the single host sync per tick fetches the whole
+        ``(S, T)`` token block plus the finished vector. Returns the
         requests that reached a terminal state this tick."""
         t0 = time.perf_counter()
         tick = self._sched.tick_count
         finished = self._sched.expire(tick)
+        tokens_this_tick = 0
 
         with annotate("serve.admit"):
             while self._sched.queue_depth and self.pool.free_count:
@@ -321,46 +375,85 @@ class ServeEngine:
                         ms=round((time.perf_counter() - tp) * 1e3, 3),
                     )
                 self.metrics.record_first_token(req, tick, bucket=bucket)
+                tokens_this_tick += 1
                 done = self._sched.activate(slot, req, first, tick)
                 if done is not None:
                     finished.append(done)
 
+        # slot occupancy AS OF the decode dispatch: with fused blocks a
+        # request can join and retire inside one tick, so sampling after
+        # retirement would report empty slots that were busy all block
+        leased_this_tick = self.pool.leased_count
+
         if self._sched.active:
             n_active = len(self._sched.active)
-            # live KV rows this step actually attends (pos + 1 per
-            # active slot) vs the dense-over-cache_len read it replaced
-            # — the decode FLOP-utilization figure in the metrics
-            live_kv = sum(
-                st.pos + 1 for st in self._sched.active.values()
+            states = list(self._sched.active.items())
+            # write positions BEFORE the block: consume() advances the
+            # host mirrors, and the live-KV accounting below needs the
+            # per-slot starting frontier
+            pre_pos = {slot: st.pos for slot, st in states}
+            tok, rem, eos, min_rem = self._sched.decode_block_inputs(
+                self.pad_id
             )
-            tok, pos = self._sched.decode_inputs(self.pad_id)
+            t_block = self._block_size(min_rem)
             with annotate("serve.decode"):
                 td = time.perf_counter()
-                nxt, buffers = self._decode(
+                toks, live, buffers, positions = self._decode(
                     self.variables, self.pool.buffers,
-                    jnp.asarray(tok), jnp.asarray(pos),
+                    self.pool.positions, self.pool.live,
+                    jnp.asarray(tok), jnp.asarray(rem),
+                    jnp.asarray(eos), t_block,
                 )
-                # the inputs were DONATED: rebind the pool to the step's
-                # outputs before anything can touch the stale references
+                # the inputs were DONATED: rebind the pool's device
+                # state (buffers AND positions/live) to the block's
+                # outputs before anything can touch stale references
                 self.pool.buffers = buffers
-                nxt = np.asarray(nxt)  # host sync: (S,) int32 only
+                self.pool.positions = positions
+                self.pool.live = live
+                # the ONE host sync per block: (S, T) tokens + the
+                # per-slot finished vector come back together
+                toks_h, live_h = jax.device_get((toks, live))
                 decode_s = time.perf_counter() - td
-                self.metrics.record_decode(
-                    n_active, decode_s,
-                    live_kv=live_kv, cache_len=self.cache_len,
-                )
+            blk_finished, consumed = self._sched.consume(toks_h, tick)
+            n_tokens = sum(consumed.values())
+            tokens_this_tick += n_tokens
+            # live KV rows the block actually attended, per slot: its
+            # c consumed micro-steps read frontiers pos0+1 .. pos0+c
+            # (an arithmetic series) — vs the c * cache_len rows a
+            # dense read would touch, the FLOP-utilization figure
+            live_kv = sum(
+                c * (pre_pos[slot] + 1) + c * (c - 1) // 2
+                for slot, c in consumed.items()
+            )
+            self.metrics.record_decode(
+                n_active, decode_s, tokens_emitted=n_tokens,
+                block=t_block, live_kv=live_kv, cache_len=self.cache_len,
+            )
+            if __debug__:
+                # the device live mask and the host's retirement
+                # bookkeeping must agree slot for slot — the parity
+                # contract's cheap runtime cross-check
+                for slot, _st in states:
+                    assert bool(live_h[slot]) == (
+                        slot in self._sched.active
+                    ), (
+                        f"device live mask and host retirement disagree "
+                        f"for slot {slot} (block T={t_block})"
+                    )
             decode_ms = round(decode_s * 1e3, 3)
-            for st in self._sched.active.values():
+            for slot, st in states:
                 span = self._spans.get(st.req.id)
                 if span is not None:
-                    span.event("decode", tick=tick, pos=st.pos,
-                               n_active=n_active, step_ms=decode_ms)
-            finished.extend(self._sched.consume(nxt, tick))
+                    span.event("decode", tick=tick, pos=pre_pos[slot],
+                               n_active=n_active, block=t_block,
+                               tokens=consumed.get(slot, 0),
+                               step_ms=decode_ms)
+            finished.extend(blk_finished)
 
         self._sched.tick_count += 1
         self.metrics.sample_tick(
-            self._sched.queue_depth, self.pool.leased_count,
-            time.perf_counter() - t0,
+            self._sched.queue_depth, leased_this_tick,
+            time.perf_counter() - t0, tokens_emitted=tokens_this_tick,
         )
         for res in finished:
             self.metrics.record_finish(res)

@@ -40,9 +40,13 @@ def _rnd(value: float | None, digits: int = 3) -> float | None:
 
 class ServeMetrics:
     def __init__(self, model: str, slots: int,
-                 registry: MetricRegistry | None = None):
+                 registry: MetricRegistry | None = None,
+                 decode_block: int = 1):
         self.model = model
         self.slots = slots
+        #: the engine's configured max fused-block size (T); surfaced in
+        #: to_dict so dashboards can normalize block-aware figures
+        self.decode_block = decode_block
         self.registry = registry if registry is not None else MetricRegistry()
         r = self.registry
         self._submitted = r.counter("serve.submitted")
@@ -69,6 +73,10 @@ class ServeMetrics:
         #: prefill count per padded bucket length (str keys: the dict
         #: rides the flat JSON line as-is)
         self.prefill_buckets: dict[str, int] = {}
+        #: fused-block count per actual block size run (ladder usage)
+        self.decode_blocks: dict[str, int] = {}
+        #: real tokens emitted per tick (first tokens + block tokens)
+        self.tick_tokens: list[int] = []
         self._t0: float | None = None
         self._t_last: float | None = None
 
@@ -128,15 +136,27 @@ class ServeMetrics:
             self.prefill_buckets[key] = self.prefill_buckets.get(key, 0) + 1
 
     def record_decode(self, n_active: int, seconds: float,
+                      tokens_emitted: int | None = None,
+                      block: int = 1,
                       live_kv: int | None = None,
                       cache_len: int | None = None) -> None:
+        """One fused decode dispatch: ``seconds`` of wall time that
+        emitted ``tokens_emitted`` REAL tokens. Defaults to ``n_active``
+        — the T=1 step, where every active slot emits exactly one token
+        — so the single-step path is unchanged (asserted equal-path in
+        tests). For T>1 blocks the caller passes the consumed count, so
+        ``per_token_ms`` divides by tokens actually emitted, not by
+        slots times scan length."""
+        tokens = n_active if tokens_emitted is None else tokens_emitted
         self.decode_seconds += seconds
-        self.decode_tokens += n_active
-        if n_active:
-            self._per_token_ms.record(seconds / n_active * 1e3)
+        self.decode_tokens += tokens
+        if tokens:
+            self._per_token_ms.record(seconds / tokens * 1e3)
+        key = str(block)
+        self.decode_blocks[key] = self.decode_blocks.get(key, 0) + 1
         if live_kv is not None and cache_len is not None:
             self.decode_live_kv += live_kv
-            self.decode_dense_kv += n_active * cache_len
+            self.decode_dense_kv += tokens * cache_len
 
     def record_finish(self, result) -> None:
         if result.status == "expired":
@@ -146,11 +166,17 @@ class ServeMetrics:
         self._tokens_generated.inc(result.generated)
         self._touch()
 
-    def sample_tick(self, queue_depth: int, leased: int,
-                    seconds: float) -> None:
+    def sample_tick(self, queue_depth: int, leased: int, seconds: float,
+                    tokens_emitted: int = 0) -> None:
+        """One scheduler tick. ``tokens_emitted`` is the REAL token
+        count the tick produced (admissions' first tokens + the decode
+        block's consumed tokens) — explicit, because with fused blocks a
+        tick emits up to S*T tokens and attributing its wall time to one
+        token would inflate every per-token figure T-fold."""
         self.queue_depth_samples.append(queue_depth)
         self.util_samples.append(leased / self.slots)
         self.tick_seconds.append(seconds)
+        self.tick_tokens.append(tokens_emitted)
         self._tick_ms.record(seconds * 1e3)
         self._touch()
 
@@ -221,6 +247,15 @@ class ServeMetrics:
                 if self.decode_dense_kv else None
             ),
             "prefill_buckets": dict(self.prefill_buckets),
+            # fused decode blocks (docs/SERVING.md "Decode blocks"):
+            # the configured max T, mean real tokens per tick, and how
+            # often each ladder size actually ran
+            "decode_block": self.decode_block,
+            "tokens_per_tick": (
+                _rnd(_mean(self.tick_tokens))
+                if self.tick_tokens else 0.0
+            ),
+            "decode_blocks": dict(self.decode_blocks),
         }
 
     def snapshot(self) -> list[MetricData]:

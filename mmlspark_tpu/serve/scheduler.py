@@ -1,15 +1,18 @@
 """Continuous-batching scheduler: queue, slot states, and tick
 bookkeeping for the serving engine.
 
-The loop shape (one TICK = admit joiners -> one fused decode step for
-every active slot -> retire finished sequences) is the in-process analog
-of TensorFlow's decoupled dataflow workers (arXiv:1605.08695): requests
-of different lengths and arrival times share ONE compiled device step,
-because every tick presents the device with the same static shapes —
-``(S,)`` tokens, ``(S,)`` positions, the pool's ``(S, L, hk, d)``
-buffers. A sequence hitting EOS or its token budget frees its slot
-without stalling the rest of the batch; the next queued request takes
-the slot on the following tick.
+The loop shape (one TICK = admit joiners -> one fused decode BLOCK of up
+to T tokens for every active slot -> retire finished sequences) is the
+in-process analog of TensorFlow's decoupled dataflow workers
+(arXiv:1605.08695): requests of different lengths and arrival times
+share ONE compiled device program per block size, because every tick
+presents the device with the same static shapes — ``(S,)`` tokens,
+budgets and EOS ids, the pool's ``(S,)`` device positions/live mask and
+``(S, L, hk, d)`` buffers. Admission and retirement happen at BLOCK
+boundaries: a sequence hitting EOS mid-block goes dead on device
+(emitting pads for the rest of the block) and frees its slot when the
+block's tokens are consumed; the next queued request takes the slot on
+the following tick.
 
 This module is pure host-side bookkeeping (no jax): the engine owns the
 jitted prefill/decode programs and the metrics, the scheduler owns who
@@ -149,39 +152,69 @@ class ContinuousBatchScheduler:
         self.active[slot] = st
         return None
 
-    def decode_inputs(self, pad_id: int) -> tuple[np.ndarray, np.ndarray]:
-        """The fused step's ``(S,)`` token and position vectors. Free
-        slots carry (pad, 0) — they run through the fixed-shape compute
-        and their outputs (and position-0 garbage writes into their own
-        free buffers) are ignored; the next lease's prefill overwrites
-        position 0 before anything reads it."""
-        tok = np.full((self.pool.num_slots,), pad_id, np.int32)
-        pos = np.zeros((self.pool.num_slots,), np.int32)
+    def decode_block_inputs(
+        self, pad_id: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """Host-side inputs for one fused decode BLOCK: the ``(S,)``
+        last-token, remaining-budget and EOS-id vectors (-1 = no EOS),
+        plus the MINIMUM remaining budget over active slots — the engine
+        clamps the block size to it, so no slot can overrun its budget
+        mid-block (budget death only ever lands exactly on a block
+        boundary). Positions and the live mask are NOT built here: they
+        live on device (``pool.positions`` / ``pool.live``), advanced by
+        the scanned micro-steps between host syncs. Free slots carry
+        (pad, 0 budget, -1): their device live flag is False, so the
+        block emits pads for them and their only writes are position-0
+        garbage the next lease's prefill overwrites. Requires at least
+        one active slot."""
+        s = self.pool.num_slots
+        tok = np.full((s,), pad_id, np.int32)
+        rem = np.zeros((s,), np.int32)
+        eos = np.full((s,), -1, np.int32)
         for slot, st in self.active.items():
             tok[slot] = st.last_token
-            pos[slot] = st.pos
-        return tok, pos
+            rem[slot] = st.req.max_new_tokens - len(st.out)
+            eos[slot] = -1 if st.req.eos_id is None else st.req.eos_id
+        min_rem = int(min(
+            st.req.max_new_tokens - len(st.out)
+            for st in self.active.values()
+        ))
+        return tok, rem, eos, min_rem
 
-    def consume(self, next_tokens: np.ndarray,
-                tick: int) -> list[RequestResult]:
-        """Fold one fused decode step's output back into per-slot state;
-        retire sequences that hit EOS or their token budget, freeing
-        their slots for the next tick's admissions."""
+    def consume(
+        self, token_block: np.ndarray, tick: int
+    ) -> tuple[list[RequestResult], dict[int, int]]:
+        """Fold one fused decode BLOCK's ``(S, T)`` token output back
+        into per-slot state: each active slot consumes its row left to
+        right until its EOS or token budget retires it (columns after
+        that are device-emitted pads — discarded), freeing retired slots
+        for the next tick's admissions. A ``(S,)`` vector is accepted as
+        a T=1 block. Returns ``(finished results, {slot: real tokens
+        consumed})`` — the consumed counts are what per-token metrics
+        divide by."""
+        token_block = np.asarray(token_block)
+        if token_block.ndim == 1:
+            token_block = token_block[:, None]
         finished: list[RequestResult] = []
+        consumed: dict[int, int] = {}
         for slot, st in list(self.active.items()):
-            nxt = int(next_tokens[slot])
-            st.out.append(nxt)
-            st.pos += 1
-            st.last_token = nxt
             req = st.req
-            done = len(st.out) >= req.max_new_tokens or (
-                req.eos_id is not None and nxt == req.eos_id
-            )
-            if done:
-                del self.active[slot]
-                self.pool.free(slot)
-                finished.append(self._finish(st, "completed", tick))
-        return finished
+            taken = 0
+            for col in range(token_block.shape[1]):
+                nxt = int(token_block[slot, col])
+                st.out.append(nxt)
+                st.pos += 1
+                st.last_token = nxt
+                taken += 1
+                if len(st.out) >= req.max_new_tokens or (
+                    req.eos_id is not None and nxt == req.eos_id
+                ):
+                    del self.active[slot]
+                    self.pool.free(slot)
+                    finished.append(self._finish(st, "completed", tick))
+                    break
+            consumed[slot] = taken
+        return finished, consumed
 
     # -- result assembly ---------------------------------------------------
 

@@ -2,14 +2,21 @@
 across a block of work.
 
 The serving engine's whole design rests on compile-count invariants —
-the fused decode step compiles EXACTLY once no matter how requests join
-and leave, and bucketed prefill compiles at most once per length bucket
-(docs/SERVING.md). Those invariants used to be asserted ad hoc at the
-end of individual tests; this context manager makes them reusable and
-makes the failure mode loud and specific::
+the fused decode BLOCK compiles at most once per power-of-two ladder
+size (``decode_compile_count`` counts DISTINCT XLA programs, never scan
+iterations), and bucketed prefill compiles at most once per length
+bucket (docs/SERVING.md). Those invariants used to be asserted ad hoc
+at the end of individual tests; this context manager makes them
+reusable and makes the failure mode loud and specific::
 
     with compile_guard(lambda: engine.decode_compile_count,
-                       max_programs=1, min_programs=1, label="decode"):
+                       max_programs=engine.num_decode_blocks,
+                       min_programs=1, label="decode"):
+        ... drive traffic ...
+
+or, pinning both serve programs to the engine's own ceilings at once::
+
+    with serve_compile_guard(engine):
         ... drive traffic ...
 
 Any callable returning a monotonically non-decreasing program count
@@ -68,3 +75,25 @@ def compile_guard(count_fn: Callable[[], int], *, max_programs: int,
             f"{min_programs} — the guarded block never reached the "
             "jitted path it was meant to exercise"
         )
+
+
+@contextmanager
+def serve_compile_guard(engine, *, min_decode: int = 0,
+                        min_prefill: int = 0,
+                        label: str = "serve") -> Iterator[None]:
+    """Pin BOTH of a ``ServeEngine``'s jitted programs to their design
+    ceilings across the block: the fused decode block to its
+    power-of-two ladder (``num_decode_blocks`` distinct programs — one
+    per scan length T actually run, NOT one per scan iteration) and
+    bucketed prefill to ``num_prefill_buckets``. The one-line spelling
+    of the serving compile contract for tests that drive traffic."""
+    with compile_guard(
+        lambda: engine.decode_compile_count,
+        max_programs=engine.num_decode_blocks,
+        min_programs=min_decode, label=f"{label}.decode",
+    ), compile_guard(
+        lambda: engine.prefill_compile_count,
+        max_programs=engine.num_prefill_buckets,
+        min_programs=min_prefill, label=f"{label}.prefill",
+    ):
+        yield

@@ -126,6 +126,60 @@ def test_chained_op_seconds_contract(monkeypatch, tmp_path):
     assert len(calls) < 6
 
 
+def test_final_stdout_line_is_compact_json(monkeypatch, tmp_path, capsys):
+    """The PRINTED terminal line must parse as JSON and stay under the
+    compact budget even when the full payload is enormous (the driver's
+    bounded tail capture truncates long lines to null) — with the full
+    payload written next to bench.py as BENCH_FULL.json."""
+    bench = _bench(monkeypatch, tmp_path)
+    monkeypatch.setenv(
+        "MMLTPU_BENCH_FULL_PATH", str(tmp_path / "BENCH_FULL.json")
+    )
+    # a deliberately bloated payload: per-group dumps far past the limit
+    results = {
+        "images_per_sec_per_chip": 427020.0,
+        "group_backends": {"inference": "tpu"},
+        "group_seconds": {g: 12.3456789 for g in bench._GROUPS},
+        "decode": {
+            "kv_vs_recompute_speedup": 3.1,
+            "decode_blocks": {"speedup_t8_vs_t1": 2.4},
+            "blob": ["x" * 64] * 64,
+        },
+        "serve": {"tokens_per_sec": 512.5, "blob": ["y" * 64] * 64},
+    }
+    line = bench._final_line(results, attempt=1)
+    assert len(json.dumps(line).encode()) > bench._COMPACT_LIMIT_BYTES
+    assert bench._emit(line) is True
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)  # valid JSON ...
+    assert len(out.encode()) < 1500  # ... under the tail-capture budget
+    assert parsed["value"] == 427020.0
+    assert parsed["full"] == "BENCH_FULL.json"
+    assert "group_seconds" in parsed
+    # headline figures surface speedups/throughput without the blobs
+    assert any("speedup" in k for k in parsed.get("headlines", {}))
+    # the full payload survives intact on disk
+    with open(tmp_path / "BENCH_FULL.json", encoding="utf-8") as f:
+        full = json.load(f)
+    assert full["decode"]["blob"][0] == "x" * 64
+    # exactly-once: a second emit is a no-op
+    assert bench._emit(line) is False
+
+
+def test_compact_line_sheds_until_under_budget(monkeypatch, tmp_path):
+    """Progressive shedding: even a pathological error string cannot
+    push the compact line past the budget."""
+    bench = _bench(monkeypatch, tmp_path)
+    line = bench._final_line(
+        {"group_seconds": {f"g{i}": 1.0 for i in range(40)}},
+        attempt=3, error="E" * 5000,
+    )
+    compact = bench._compact_line(line)
+    assert len(json.dumps(compact).encode()) <= bench._COMPACT_LIMIT_BYTES
+    assert compact["error"].startswith("E")
+    assert compact["error_class"] == "bench_failure"
+
+
 def test_vs_baseline_is_own_committed_record(monkeypatch, tmp_path):
     """The reference publishes no numbers, so vs_baseline is the ratio
     against the repo's newest committed BENCH_LOCAL_r*.json headline —

@@ -24,7 +24,10 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.metrics_contracts import MetricData
 from mmlspark_tpu.models import build_model, generate
 from mmlspark_tpu.serve import ServeEngine, SlotCachePool
-from mmlspark_tpu.testing.compile_guard import compile_guard
+from mmlspark_tpu.testing.compile_guard import (
+    compile_guard,
+    serve_compile_guard,
+)
 
 PERIOD = 4
 
@@ -103,7 +106,8 @@ def test_staggered_arrivals_match_generate(config):
     results = {}
     rid_to_idx = {}
     with compile_guard(lambda: engine.decode_compile_count,
-                       max_programs=1, min_programs=1, label="decode"):
+                       max_programs=engine.num_decode_blocks,
+                       min_programs=1, label="decode"):
         for i, p in enumerate(prompts):  # staggered: one submit per tick
             rid_to_idx[engine.submit(p, max_new_tokens=8)] = i
             for res in engine.step():
@@ -133,7 +137,9 @@ def test_more_requests_than_slots_still_match():
     for rid, p in zip(rids, prompts):
         want = np.asarray(generate(m, v, p[None], max_new_tokens=6))[0]
         np.testing.assert_array_equal(np.asarray(results[rid].tokens), want)
-    assert engine.decode_compile_count == 1
+    # distinct XLA programs, one per ladder block size actually run —
+    # never one per token or per scan iteration
+    assert 1 <= engine.decode_compile_count <= engine.num_decode_blocks
 
 
 def test_eos_retires_early():
@@ -259,11 +265,7 @@ def test_mixed_length_soak_pins_compile_counts():
     engine = ServeEngine(m, v, slots=2, cache_len=32, max_queue=16)
     assert engine.num_prefill_buckets == 3  # 8, 16, 32
     rids = []
-    with compile_guard(lambda: engine.decode_compile_count,
-                       max_programs=1, min_programs=1, label="decode"), \
-         compile_guard(lambda: engine.prefill_compile_count,
-                       max_programs=engine.num_prefill_buckets,
-                       min_programs=1, label="prefill"):
+    with serve_compile_guard(engine, min_decode=1, min_prefill=1):
         results = {}
         for i, p in enumerate(prompts):  # two joiners per tick
             rids.append(engine.submit(p, max_new_tokens=4))
@@ -313,7 +315,7 @@ def test_demo_soak():
     out = run_demo(slots=3, n_requests=10, max_new_tokens=6,
                    arrivals_per_tick=2, cache_len=48, seed=1)
     assert out["completed"] == 10 and out["expired"] == 0
-    assert out["decode_compiles"] == 1
+    assert 1 <= out["decode_compiles"] <= out["decode_block"].bit_length()
     assert out["tokens_generated"] == 60
 
 
@@ -337,4 +339,6 @@ def test_cli_serve_demo_emits_one_json_line():
                 "slot_utilization_mean", "tokens_per_sec"):
         assert key in metrics, key
     assert metrics["completed"] == 4
-    assert metrics["decode_compiles"] == 1
+    assert 1 <= metrics["decode_compiles"] <= (
+        metrics["decode_block"].bit_length()
+    )

@@ -67,6 +67,10 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "tick_ms_p50": NUM,
     "tick_ms_p95": NUM,
     "tick_ms_p99": NUM,
+    # fused decode blocks (tests/test_decode_block.py)
+    "decode_block": (int,),
+    "tokens_per_tick": NUM,
+    "decode_blocks": (dict,),
     # demo envelope
     "n_requests": (int,),
     "decode_compiles": (int,),

@@ -84,6 +84,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
     rm -rf "$(dirname "$venv_dir")"
   fi
 
+  step "decode-block parity gate (fused blocks == generate(), every T)"
+  python -m pytest tests/test_decode_block.py -q
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
