@@ -117,6 +117,7 @@ _GROUPS = {
     "feed_synth": ("feed_synth",),
     "decode": ("decode",),
     "serve": ("serve",),
+    "serve_sharded": ("serve_sharded",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -822,6 +823,66 @@ def bench_serve(jax) -> dict:
     return {"serve": out}
 
 
+def bench_serve_sharded() -> dict:
+    """Mesh-sharded serving scaling sweep (docs/SERVING.md "Sharded
+    serving"): the SAME synthetic-traffic demo as the ``serve`` group,
+    but through the sharded engine at four (data, model) mesh shapes —
+    1x1 / 4x1 / 2x2 / 8x1 — each in its own subprocess on an 8-device
+    virtual CPU mesh (``--cpu-mesh 8``), because the mesh topology must
+    be fixed before the first jax import. Tunnel-immune by construction,
+    like ``feed_synth``.
+
+    The numbers to read: ``tokens_per_sec_<DxM>`` per shape and
+    ``speedup_<DxM>`` vs the 1x1 baseline — on the CPU mesh the data
+    axis is the one that scales (more slots decoded per dispatch with
+    the same program count), while 1x1 vs the plain ``serve`` group
+    bounds the sharding machinery's constant overhead. Compile-count
+    pins ride along per shape (``decode_compiles`` /
+    ``prefill_compiles``) — the sharded engine must hit the same
+    ladder, or GSPMD is retracing per tick."""
+    shapes = [(1, 1), (4, 1), (2, 2), (8, 1)]
+    smoke = _cpu_smoke_mode()
+    out: dict = {"shapes": {}}
+    base_tps = None
+    for d, m in shapes:
+        label = f"{d}x{m}"
+        budget = min(
+            300.0, max(60.0, _wall_remaining() - _EMIT_RESERVE_S - 30)
+        )
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "8",
+            "serve", "--demo",
+            "--slots", "8",
+            "--requests", "4" if smoke else "16",
+            "--max-new-tokens", "4" if smoke else "16",
+            "--mesh", f"data={d},model={m}",
+        ]
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=budget,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"sharded serve demo {label} failed: "
+                f"{(r.stderr or r.stdout)[-300:]}"
+            )
+        metrics = json.loads(r.stdout.strip().splitlines()[-1])
+        tps = metrics.get("tokens_per_sec")
+        out["shapes"][label] = {
+            k: metrics.get(k)
+            for k in ("tokens_per_sec", "mesh_shape", "mesh_devices",
+                      "cache_pool_bytes_per_device", "decode_compiles",
+                      "prefill_compiles", "ttft_ms_p50",
+                      "per_token_ms_p50")
+        }
+        if tps:
+            out[f"tokens_per_sec_{label}"] = tps
+            if (d, m) == (1, 1):
+                base_tps = tps
+            elif base_tps:
+                out[f"speedup_{label}"] = round(tps / base_tps, 3)
+    return {"serve_sharded": out}
+
+
 def bench_feed_synth() -> dict:
     """Feed-machinery overhead bound WITHOUT the relay (VERDICT r4 next
     #7): tools/feed_overhead_bench.py re-execs onto the CPU backend
@@ -1263,6 +1324,8 @@ def run(attempt: int) -> dict:
         "flash_long": lambda: bench_flash_long(jax, jnp),
         "stage": lambda: bench_stage_inference(jax, *flagship()),
         "feed_synth": bench_feed_synth,
+        # tunnel-immune CPU subprocesses too, same dead-last rationale
+        "serve_sharded": bench_serve_sharded,
     }
     # MMLTPU_BENCH_GROUPS=resnet50,inference runs a subset — lets a
     # short-lived healthy tunnel spend its minutes on the headline

@@ -77,6 +77,7 @@ def cmd_serve(args) -> int:
         arrivals_per_tick=args.arrivals_per_tick,
         seed=args.seed,
         decode_block=args.decode_block,
+        mesh=args.mesh or None,
         telemetry_dir=args.telemetry_dir or None,
     )
     print(json.dumps(metrics, default=str))
@@ -200,6 +201,15 @@ def main(argv: list[str] | None = None) -> int:
         help="max fused decode-block size: up to T tokens per dispatch "
         "and per host sync (power-of-two ladder; default: engine's 32; "
         "1 = the old per-token stepping)",
+    )
+    sp.add_argument(
+        "--mesh", default="", metavar="AXES",
+        help="run the SHARDED engine on a (data, model) device mesh, "
+        "e.g. 'data=4,model=2' (one axis may be -1 = inferred): slots "
+        "and the KV pool shard over the data axis, params Megatron-"
+        "style over the model axis; slots must divide by the data-axis "
+        "size. Combine with --cpu-mesh N to develop on N virtual CPU "
+        "devices (docs/SERVING.md 'Sharded serving')",
     )
     sp.add_argument(
         "--telemetry-dir", default="", metavar="DIR",

@@ -155,6 +155,16 @@ def make_decode_block(graph, pad_id: int = 0):
     bit-identical to single-request greedy ``generate()`` up to and
     including its EOS / last budgeted token; columns after that are
     pads the host discards.
+
+    The block is GSPMD-cleanly partitionable: every per-slot input
+    (``pos``/``live``/``tok``/``rem``/``eos``, the buffers' slot dim)
+    is elementwise over S, so sharding S over a mesh's data axis splits
+    the scan across devices with no cross-slot collectives, while
+    model-axis-sharded ``variables`` add the usual Megatron psums
+    inside ``_cached_apply``. The serving engine jits this with
+    ``out_shardings`` pinned to the pool's shardings and every input
+    committed, so ticks re-enter one cached program
+    (docs/SERVING.md "Sharded serving").
     """
 
     def decode_block(variables, buffers, pos, live, tok, rem, eos, t):

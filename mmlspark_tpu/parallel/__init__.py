@@ -18,6 +18,7 @@ from mmlspark_tpu.parallel.mesh import (  # noqa: F401
     batch_spec,
     initialize_distributed,
     make_mesh,
+    parse_mesh_axes,
     replicated_spec,
 )
 from mmlspark_tpu.parallel.pipeline import (  # noqa: F401
@@ -41,4 +42,5 @@ from mmlspark_tpu.parallel.sharding import (  # noqa: F401
     build_param_shardings,
     shard_params,
     spec_for_path,
+    unmatched_param_paths,
 )

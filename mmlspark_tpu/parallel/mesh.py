@@ -24,6 +24,34 @@ PIPELINE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
 
 
+def parse_mesh_axes(spec: str) -> dict[str, int]:
+    """Parse the CLI/bench mesh spelling ``"data=4,model=2"`` into the
+    axes mapping :func:`make_mesh` takes. A size of ``-1`` (one axis at
+    most) is inferred from the device count, exactly as in
+    :func:`make_mesh`; whitespace around entries is ignored."""
+    axes: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, size = part.partition("=")
+        name = name.strip()
+        try:
+            if not eq or not name:
+                raise ValueError
+            axes[name] = int(size)
+        except ValueError:
+            raise FriendlyError(
+                f"bad mesh spec {spec!r}: each entry must be "
+                f"'axis=size' (e.g. 'data=4,model=2'), got {part!r}"
+            ) from None
+    if not axes:
+        raise FriendlyError(
+            f"bad mesh spec {spec!r}: no axes (e.g. 'data=4,model=2')"
+        )
+    return axes
+
+
 def make_mesh(
     axes: Mapping[str, int] | None = None,
     devices: Sequence | None = None,

@@ -28,6 +28,16 @@ from mmlspark_tpu.parallel.mesh import MODEL_AXIS
 #: (models/transformer.py): column-parallel into attention/MLP, row-parallel
 #: out of them — the matched pairs keep activations replicated at block
 #: boundaries with one psum per block, which XLA derives automatically.
+#: The embedding/unembed/norm tails are EXPLICIT (not left to the
+#: unmatched-replicates fallback): the token embedding shards its vocab
+#: rows and the lm_head its vocab columns over the model axis (the
+#: Megatron vocab-parallel pair — a gather, respectively a concat, with
+#: no cross-shard reduction, so greedy decode stays bit-identical),
+#: while norms, row-parallel output biases (added once AFTER the psum),
+#: and the learned position table replicate by design.
+#: :func:`unmatched_param_paths` audits that a model's whole tree is
+#: covered — any path it returns is a param these rules never
+#: considered, replicating silently.
 TRANSFORMER_TP_RULES: list[tuple[str, tuple]] = [
     (r"qkv/kernel$", (None, MODEL_AXIS)),
     (r"attn_out/kernel$", (MODEL_AXIS, None)),
@@ -35,6 +45,15 @@ TRANSFORMER_TP_RULES: list[tuple[str, tuple]] = [
     (r"mlp_out/kernel$", (MODEL_AXIS, None)),
     (r"qkv/bias$", (MODEL_AXIS,)),
     (r"mlp_in/bias$", (MODEL_AXIS,)),
+    # embedding / unembed (vocab-parallel pair)
+    (r"token/embedding$", (MODEL_AXIS, None)),
+    (r"head/kernel$", (None, MODEL_AXIS)),
+    (r"head/bias$", (MODEL_AXIS,)),
+    # explicitly replicated: norms, row-parallel biases, position table
+    (r"(ln1|ln2|ln_f)/(scale|bias)$", ()),
+    (r"attn_out/bias$", ()),
+    (r"mlp_out/bias$", ()),
+    (r"embed/params/pos$", ()),
 ]
 
 
@@ -83,3 +102,29 @@ def build_param_shardings(params, mesh,
 def shard_params(params, mesh, rules=None):
     """device_put the param tree according to the rules."""
     return jax.device_put(params, build_param_shardings(params, mesh, rules))
+
+
+def unmatched_param_paths(params,
+                          rules: Sequence[tuple[str, tuple]]) -> list[str]:
+    """Param paths in ``params`` that NO rule matches — the whole-model
+    rule-coverage audit in one call.
+
+    An unmatched param silently replicates (``spec_for_path`` falls
+    back to ``P()``), which is correct for small tails but is exactly
+    how a new 7B-scale weight sneaks past tensor parallelism unsharded.
+    Empty list = every param was explicitly considered. Note the rules
+    MATCHING a path is a weaker statement than it being sharded: a rule
+    may deliberately replicate (spec ``()``), and
+    :func:`build_param_shardings` still degrades unevenly-divisible
+    dims — this audit is about coverage, not placement.
+    """
+    out: list[str] = []
+
+    def one(key_path, _leaf):
+        path = _path_str(key_path)
+        if not any(re.search(pat, path) for pat, _ in rules):
+            out.append(path)
+        return _leaf
+
+    jax.tree_util.tree_map_with_path(one, params)
+    return sorted(out)

@@ -19,14 +19,29 @@ Stale K/V from a previous lease is harmless by construction: a new lease
 always prefills ``[0, P)`` with ``P >= 1``, and the causal mask
 (``q_offset = pos``) hides every position beyond the current request's
 own write frontier.
+
+With ``mesh`` set (docs/SERVING.md "Sharded serving") the pool is the
+engine's device-placement anchor: every buffer is allocated COMMITTED
+to a fixed :class:`~jax.sharding.NamedSharding` — the slot dim over the
+``data`` axis, the KV-head dim over the ``model`` axis when it divides
+evenly — and every eager update (``write_prefill``, ``free``) is
+re-committed to the same sharding before the decode block sees it.
+That fixed-point is what keeps the sharded engine's jitted programs at
+ONE signature-cache entry per program family: the fused block's
+donated inputs and ``out_shardings``-pinned outputs present byte-for-
+byte identical shardings on every tick.
 """
 
 from __future__ import annotations
 
+import math
+
+import jax
 import jax.numpy as jnp
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.models.generate import cache_geometry
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
 class SlotCachePool:
@@ -39,7 +54,8 @@ class SlotCachePool:
     device and are replaced functionally each tick.
     """
 
-    def __init__(self, graph, variables, slots: int, cache_len: int):
+    def __init__(self, graph, variables, slots: int, cache_len: int, *,
+                 mesh=None):
         if slots < 1:
             raise FriendlyError(f"slots must be >= 1, got {slots}")
         if cache_len < 2:
@@ -54,17 +70,48 @@ class SlotCachePool:
                 "serving engine needs the KV-cache decode path "
                 "(transformer_lm family)"
             )
+        self.mesh = mesh
+        if mesh is not None:
+            data = int(mesh.shape.get(DATA_AXIS, 1))
+            if slots % data:
+                raise FriendlyError(
+                    f"slots ({slots}) must be a multiple of the mesh's "
+                    f"'{DATA_AXIS}' axis ({data}): each device in the "
+                    "data axis holds slots/data whole slot rows of "
+                    "every K/V buffer. Round slots up (free slots are "
+                    "natural pad rows — dead on device, zero decode "
+                    "cost beyond the fixed shapes) or shrink the axis"
+                )
         self.num_slots = slots
         self.cache_len = cache_len
+        # device-placement anchors under a mesh; None on a single device
+        self._slot_sharding = self._kv_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._slot_sharding = NamedSharding(mesh, P(DATA_AXIS))
+            msize = int(mesh.shape.get(MODEL_AXIS, 1))
+            self._kv_shardings = {}
+            for name, (hk, d) in geometry.items():
+                # shard KV heads over the model axis only when they tile
+                # evenly (GQA/MQA models with hk < model size replicate
+                # the head dim, mirroring build_param_shardings' degrade)
+                head = (
+                    MODEL_AXIS if msize > 1 and hk % msize == 0 else None
+                )
+                sh = NamedSharding(mesh, P(DATA_AXIS, None, head, None))
+                self._kv_shardings[name] = (sh, sh)
         self.buffers = {}
         for name, (hk, d) in geometry.items():
             # K and V must be DISTINCT arrays: the engine's decode step
             # donates the whole buffer pytree (donate_argnums), and a
             # pair aliasing one allocation cannot be donated twice
-            self.buffers[name] = (
-                jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16),
-                jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16),
-            )
+            k = jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16)
+            v = jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16)
+            if self._kv_shardings is not None:
+                sk, sv = self._kv_shardings[name]
+                k, v = jax.device_put(k, sk), jax.device_put(v, sv)
+            self.buffers[name] = (k, v)
         # LIFO free list popping the lowest id first keeps slot
         # assignment deterministic for the parity tests
         self._free = list(range(slots - 1, -1, -1))
@@ -78,8 +125,30 @@ class SlotCachePool:
         # Free-slot convention: (pos 0, dead) — a dead row runs through
         # the fixed-shape block masked out, writing only position-0
         # garbage that the slot's next prefill overwrites.
-        self.positions = jnp.zeros((slots,), jnp.int32)
-        self.live = jnp.zeros((slots,), bool)
+        self.positions = self._commit_slot(jnp.zeros((slots,), jnp.int32))
+        self.live = self._commit_slot(jnp.zeros((slots,), bool))
+
+    # -- sharding anchors --------------------------------------------------
+
+    def _commit_slot(self, arr):
+        """Commit an (S,)-shaped per-slot array to the data axis (no-op
+        without a mesh)."""
+        if self._slot_sharding is None:
+            return arr
+        return jax.device_put(arr, self._slot_sharding)
+
+    @property
+    def kv_shardings(self):
+        """``{block: (NamedSharding, NamedSharding)}`` matching
+        ``buffers`` — what the engine pins the decode block's
+        ``out_shardings`` to — or None without a mesh."""
+        return self._kv_shardings
+
+    @property
+    def slot_sharding(self):
+        """NamedSharding of the per-slot (S,) state (data axis), or
+        None without a mesh."""
+        return self._slot_sharding
 
     # -- accounting --------------------------------------------------------
 
@@ -117,8 +186,8 @@ class SlotCachePool:
         # restore the free-slot convention (pos 0, dead) so the fused
         # decode block keeps every write of this row inside the leased
         # region and its flash-decode length reads as zero
-        self.positions = self.positions.at[slot].set(0)
-        self.live = self.live.at[slot].set(False)
+        self.positions = self._commit_slot(self.positions.at[slot].set(0))
+        self.live = self._commit_slot(self.live.at[slot].set(False))
 
     # -- data path ---------------------------------------------------------
 
@@ -136,11 +205,38 @@ class SlotCachePool:
             )
         for name, (pk, pv) in self.buffers.items():
             ck, cv = prefill_cache[name]
-            self.buffers[name] = (
-                pk.at[slot, :length].set(ck[0, :length].astype(pk.dtype)),
-                pv.at[slot, :length].set(cv[0, :length].astype(pv.dtype)),
-            )
+            nk = pk.at[slot, :length].set(ck[0, :length].astype(pk.dtype))
+            nv = pv.at[slot, :length].set(cv[0, :length].astype(pv.dtype))
+            if self._kv_shardings is not None:
+                # the eager scatter's output sharding is whatever GSPMD
+                # propagated from mixing the pool row with the prefill
+                # cache — re-commit to the pool's canonical sharding so
+                # the decode block's donated inputs never change
+                # signature (the compile-count pins depend on it)
+                sk, sv = self._kv_shardings[name]
+                nk, nv = jax.device_put(nk, sk), jax.device_put(nv, sv)
+            self.buffers[name] = (nk, nv)
         # the slot's first decode step writes its first generated
         # token's K/V at position ``length`` (the prompt fills [0, P))
-        self.positions = self.positions.at[slot].set(length)
-        self.live = self.live.at[slot].set(True)
+        self.positions = self._commit_slot(
+            self.positions.at[slot].set(length)
+        )
+        self.live = self._commit_slot(self.live.at[slot].set(True))
+
+    # -- accounting for telemetry ------------------------------------------
+
+    def device_bytes_per_device(self) -> int:
+        """KV-pool bytes resident PER DEVICE: each array's local shard
+        size (``sharding.shard_shape``) times its itemsize, summed over
+        every K/V buffer plus the per-slot position/live state. On a
+        single device this is simply the pool's total footprint; under
+        a mesh it is what each chip's HBM actually holds — the figure
+        ``ServeMetrics.snapshot()`` reports as
+        ``cache_pool_bytes_per_device``."""
+        total = 0
+        arrays = [a for pair in self.buffers.values() for a in pair]
+        arrays += [self.positions, self.live]
+        for arr in arrays:
+            shard = arr.sharding.shard_shape(arr.shape)
+            total += math.prod(shard) * arr.dtype.itemsize
+        return int(total)

@@ -26,6 +26,7 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              depth: int = 2, cache_len: int = 64, seed: int = 0,
              deadline_ticks: int | None = None,
              decode_block: int | None = None,
+             mesh: str | None = None,
              telemetry_dir: str | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line."""
@@ -45,6 +46,9 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
     engine = ServeEngine(
         graph, variables, slots=slots, cache_len=cache_len,
         max_queue=max(n_requests, 1),
+        # "data=4,model=2"-style mesh spec -> the sharded engine
+        # (docs/SERVING.md "Sharded serving"); None = single device
+        mesh=mesh or None,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
     )

@@ -62,6 +62,11 @@ from mmlspark_tpu.models.generate import (
     init_cache,
     make_decode_block,
 )
+from mmlspark_tpu.parallel.mesh import make_mesh, parse_mesh_axes
+from mmlspark_tpu.parallel.sharding import (
+    TRANSFORMER_TP_RULES,
+    shard_params,
+)
 from mmlspark_tpu.serve.cache_pool import SlotCachePool
 from mmlspark_tpu.serve.metrics import ServeMetrics
 from mmlspark_tpu.serve.scheduler import (
@@ -69,14 +74,31 @@ from mmlspark_tpu.serve.scheduler import (
     RequestResult,
     ServeRequest,
 )
-from mmlspark_tpu.testing.compile_guard import jit_cache_size
+from mmlspark_tpu.testing.compile_guard import (
+    ProgramCountingJit,
+    jit_cache_size,
+)
 from mmlspark_tpu.utils.profiling import annotate
+
+
+def _resolve_mesh(mesh):
+    """Engine ``mesh`` argument -> jax Mesh or None. Accepts a built
+    Mesh, an axes mapping (``{"data": -1, "model": 2}``), or the CLI
+    string spelling (``"data=4,model=2"``)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, str):
+        mesh = parse_mesh_axes(mesh)
+    if isinstance(mesh, dict):
+        return make_mesh(mesh)
+    return mesh
 
 
 class ServeEngine:
     def __init__(self, graph, variables, *, slots: int = 4,
                  cache_len: int | None = None, max_queue: int = 16,
                  pad_id: int = 0, decode_block: int = 32,
+                 mesh=None,
                  recorder: FlightRecorder | None = None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
@@ -117,16 +139,39 @@ class ServeEngine:
                 "into one device program)"
             )
         self.graph = graph
-        self.variables = variables
         self.pad_id = pad_id
         self.cache_len = cache_len
         # floor to a power of two: block sizes live on the ladder
         # {1, 2, 4, ..., decode_block}, so the scan-length static arg
         # compiles O(log) program variants, never one per budget
         self.decode_block = 1 << (int(decode_block).bit_length() - 1)
-        self.pool = SlotCachePool(graph, variables, slots, cache_len)
-        self.metrics = ServeMetrics(graph.name, slots,
-                                    decode_block=self.decode_block)
+        # sharded serving (docs/SERVING.md "Sharded serving"): with a
+        # mesh, params commit to the model axis by the Megatron rules
+        # and the pool's slot-batched state to the data axis; GSPMD
+        # partitions the SAME prefill/decode programs — XLA inserts the
+        # collectives, token streams stay bit-identical to the
+        # single-device engine, and the compile-count pins hold because
+        # every per-tick input is committed to a fixed NamedSharding
+        self.mesh = _resolve_mesh(mesh)
+        self.variables = (
+            shard_params(variables, self.mesh, TRANSFORMER_TP_RULES)
+            if self.mesh is not None else variables
+        )
+        self.pool = SlotCachePool(graph, variables, slots, cache_len,
+                                  mesh=self.mesh)
+        self.metrics = ServeMetrics(
+            graph.name, slots, decode_block=self.decode_block,
+            mesh_shape=(
+                {k: int(v) for k, v in self.mesh.shape.items()}
+                if self.mesh is not None else {}
+            ),
+            mesh_devices=(
+                int(self.mesh.size) if self.mesh is not None else 1
+            ),
+            cache_pool_bytes_per_device=(
+                self.pool.device_bytes_per_device()
+            ),
+        )
         #: flight recorder (core/telemetry): one span per request
         #: lifecycle — queued -> admitted -> prefill[bucket] -> decode
         #: ticks -> finished/expired — dumpable as events.jsonl via the
@@ -168,8 +213,13 @@ class ServeEngine:
         # happens with the abstract shapes that triggered it, and lands
         # in the flight recorder's event timeline next to the request
         # that caused it
+        # ProgramCountingJit makes the counts true XLA-program counts
+        # even under a mesh, where jax's raw signature cache would
+        # re-register NamedSharding-committed args as "new shapes"
+        # (testing/compile_guard.py) — the pins and watchdog budgets
+        # therefore hold unchanged on sharded engines
         self._prefill = RetraceWatchdog(
-            jax.jit(_prefill), "serve.prefill",
+            ProgramCountingJit(jax.jit(_prefill)), "serve.prefill",
             registry=self.metrics.registry, recorder=self.recorder,
             expected_programs=self.num_prefill_buckets,
         )
@@ -181,11 +231,24 @@ class ServeEngine:
         # Contract: the engine immediately rebinds pool.buffers/
         # positions/live to the block's outputs and nothing else may
         # hold the donated references (docs/SERVING.md).
+        # under a mesh the block's loop-carried outputs are PINNED to
+        # the pool's canonical shardings (out_shardings): tick N's
+        # outputs re-enter tick N+1 with byte-identical placement, so
+        # the signature reaches its fixed point on the first call and
+        # the ladder pins hold — GSPMD would otherwise pick output
+        # shardings of its own and every tick would re-register
+        jit_kwargs = {}
+        if self.mesh is not None:
+            slot_sh = self.pool.slot_sharding
+            jit_kwargs["out_shardings"] = (
+                slot_sh, slot_sh, self.pool.kv_shardings, slot_sh,
+            )
         self._decode = RetraceWatchdog(
-            jax.jit(
+            ProgramCountingJit(jax.jit(
                 make_decode_block(graph, pad_id),
                 static_argnums=(7,), donate_argnums=(1, 2, 3),
-            ),
+                **jit_kwargs,
+            )),
             "serve.decode",
             registry=self.metrics.registry, recorder=self.recorder,
             expected_programs=self.num_decode_blocks,
@@ -396,13 +459,24 @@ class ServeEngine:
                 self.pad_id
             )
             t_block = self._block_size(min_rem)
+            if self.mesh is not None:
+                # commit the host-built per-tick vectors to the data
+                # axis (device_put: a scatter, NOT a host sync) so every
+                # tick presents the decode block one fixed signature
+                slot_sh = self.pool.slot_sharding
+                tok_d = jax.device_put(jnp.asarray(tok), slot_sh)
+                rem_d = jax.device_put(jnp.asarray(rem), slot_sh)
+                eos_d = jax.device_put(jnp.asarray(eos), slot_sh)
+            else:
+                tok_d, rem_d, eos_d = (
+                    jnp.asarray(tok), jnp.asarray(rem), jnp.asarray(eos)
+                )
             with annotate("serve.decode"):
                 td = time.perf_counter()
                 toks, live, buffers, positions = self._decode(
                     self.variables, self.pool.buffers,
                     self.pool.positions, self.pool.live,
-                    jnp.asarray(tok), jnp.asarray(rem),
-                    jnp.asarray(eos), t_block,
+                    tok_d, rem_d, eos_d, t_block,
                 )
                 # the inputs were DONATED: rebind the pool's device
                 # state (buffers AND positions/live) to the block's

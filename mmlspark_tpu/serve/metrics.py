@@ -41,12 +41,23 @@ def _rnd(value: float | None, digits: int = 3) -> float | None:
 class ServeMetrics:
     def __init__(self, model: str, slots: int,
                  registry: MetricRegistry | None = None,
-                 decode_block: int = 1):
+                 decode_block: int = 1,
+                 mesh_shape: dict[str, int] | None = None,
+                 mesh_devices: int = 1,
+                 cache_pool_bytes_per_device: int = 0):
         self.model = model
         self.slots = slots
         #: the engine's configured max fused-block size (T); surfaced in
         #: to_dict so dashboards can normalize block-aware figures
         self.decode_block = decode_block
+        #: sharded-serving topology (docs/SERVING.md "Sharded serving"):
+        #: axis name -> device count of the engine's mesh ({} on a
+        #: single device), total devices, and the KV-pool bytes each
+        #: device's HBM actually holds — the capacity-planning triple
+        #: dashboards need to normalize tokens/sec across mesh shapes
+        self.mesh_shape = dict(mesh_shape or {})
+        self.mesh_devices = mesh_devices
+        self.cache_pool_bytes_per_device = cache_pool_bytes_per_device
         self.registry = registry if registry is not None else MetricRegistry()
         r = self.registry
         self._submitted = r.counter("serve.submitted")
@@ -256,6 +267,10 @@ class ServeMetrics:
                 if self.tick_tokens else 0.0
             ),
             "decode_blocks": dict(self.decode_blocks),
+            # sharded serving (schema-gated in check_metrics_schema.py)
+            "mesh_shape": dict(self.mesh_shape),
+            "mesh_devices": self.mesh_devices,
+            "cache_pool_bytes_per_device": self.cache_pool_bytes_per_device,
         }
 
     def snapshot(self) -> list[MetricData]:

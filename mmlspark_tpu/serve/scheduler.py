@@ -165,8 +165,13 @@ class ContinuousBatchScheduler:
         the scanned micro-steps between host syncs. Free slots carry
         (pad, 0 budget, -1): their device live flag is False, so the
         block emits pads for them and their only writes are position-0
-        garbage the next lease's prefill overwrites. Requires at least
-        one active slot."""
+        garbage the next lease's prefill overwrites. Under a sharded
+        engine this free-slot convention doubles as the PAD-SLOT
+        handling for the data axis — the pool requires slots to divide
+        by the data-axis size, so a partially-occupied engine simply
+        runs some devices' rows dead, no gather/scatter of live rows
+        onto a contiguous prefix (which would change shardings and
+        retrace). Requires at least one active slot."""
         s = self.pool.num_slots
         tok = np.full((s,), pad_id, np.int32)
         rem = np.zeros((s,), np.int32)

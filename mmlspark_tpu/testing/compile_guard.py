@@ -24,10 +24,23 @@ works — ``ServeEngine.decode_compile_count`` / ``prefill_compile_count``
 wrap jax's ``jitted._cache_size()``, and a raw ``f._cache_size`` does
 too. The guard checks the DELTA across the block, so engines with prior
 traffic can still be guarded for "no NEW programs" (``max_programs=0``).
+
+SHARDED callables need more care: jax's raw ``_cache_size()`` is the
+C++ signature cache, which keys on each argument's committed-ness and
+:class:`~jax.sharding.NamedSharding` — an arg that merely changed from
+"uncommitted host array" to "committed sharded array" registers as a
+new entry even though the tracing cache hits and XLA compiles NOTHING.
+:class:`ProgramCountingJit` wraps a jitted callable and counts actual
+XLA programs instead, cross-checking the signature-cache delta against
+the backend-compile events the call really fired — NamedSharding
+re-registrations therefore never count as new programs
+(``tests/test_serve_sharded.py`` pins a sharded engine's re-tick to
+zero new programs through it).
 """
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from typing import Callable, Iterator
 
@@ -40,6 +53,84 @@ def jit_cache_size(fn) -> int:
     telemetry plane's ``RetraceWatchdog`` all read through it."""
     cache_size = getattr(fn, "_cache_size", None)
     return cache_size() if callable(cache_size) else -1
+
+
+#: jax's dispatch layer records this monitoring event once per ACTUAL
+#: backend (XLA) compilation — the ground truth ProgramCountingJit
+#: cross-checks the signature cache against
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_tls = threading.local()
+_listener_installed = False
+_listener_lock = threading.Lock()
+
+
+def _install_compile_listener() -> None:
+    """Register the process-wide backend-compile listener (once).
+    Imported lazily so merely importing this module never drags jax in."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        from jax._src import monitoring
+
+        def _on_event(event: str, duration: float, **_kw) -> None:
+            if event != _BACKEND_COMPILE_EVENT:
+                return
+            owner = getattr(_tls, "owner", None)
+            if owner is not None:
+                owner._events += 1
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+        _listener_installed = True
+
+
+class ProgramCountingJit:
+    """Wrap a jitted callable so ``_cache_size()`` counts DISTINCT XLA
+    programs, sharding-robustly.
+
+    A new program requires BOTH (a) a miss in jax's C++ signature cache
+    (the raw ``_cache_size()`` grew) AND (b) at least one backend
+    compilation actually firing during the call — so per call the
+    program count grows by ``min(signature_delta, compile_events)``.
+    Either signal alone overcounts: the signature cache re-registers
+    args whose NamedSharding/committed-ness changed without compiling
+    anything, and one warm-up call can fire auxiliary compile events
+    (e.g. interpret-mode Pallas sub-programs) beyond its one top-level
+    program. The wrapper is what ``ServeEngine`` hands its
+    ``RetraceWatchdog``s, so ``decode_compile_count`` /
+    ``prefill_compile_count`` and every ``compile_guard`` pin read
+    true program counts on sharded and unsharded engines alike.
+
+    Attribution is thread-local (compilation is synchronous inside the
+    call), so concurrent jits on other threads never cross-count.
+    """
+
+    def __init__(self, fn: Callable):
+        _install_compile_listener()
+        self._fn = fn
+        self._programs = 0
+        self._events = 0
+        self._raw_seen = max(0, jit_cache_size(fn))
+
+    def _cache_size(self) -> int:
+        """The jitted-callable counting contract (`jit_cache_size`):
+        distinct XLA programs this wrapper has observed compile."""
+        return self._programs
+
+    def __call__(self, *args, **kwargs):
+        prev_owner = getattr(_tls, "owner", None)
+        prev_events = self._events
+        _tls.owner = self
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            _tls.owner = prev_owner
+        raw = max(0, jit_cache_size(self._fn))
+        raw_delta = raw - self._raw_seen
+        self._raw_seen = raw
+        self._programs += max(0, min(raw_delta, self._events - prev_events))
+        return out
 
 
 @contextmanager

@@ -177,8 +177,14 @@ def test_tp_sharding_rules():
     spec = spec_for_path("block0/attn/attn_out/kernel", TRANSFORMER_TP_RULES,
                          mesh)
     assert tuple(spec) == ("model", None)
-    # unmatched -> replicated
+    # vocab-parallel embedding: rows over 'model' (pairs with the
+    # column-sharded lm head — no cross-shard reduction between them)
     assert tuple(spec_for_path("embed/token/embedding",
+                               TRANSFORMER_TP_RULES, mesh)) == ("model", None)
+    assert tuple(spec_for_path("z/head/kernel",
+                               TRANSFORMER_TP_RULES, mesh)) == (None, "model")
+    # unmatched -> replicated
+    assert tuple(spec_for_path("some/unknown/param",
                                TRANSFORMER_TP_RULES, mesh)) == ()
     # uneven dims degrade to replicated instead of failing
     params = {"x": {"qkv": {"kernel": jnp.zeros((8, 6))}}}  # 6 % 4 != 0

@@ -71,6 +71,12 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "decode_block": (int,),
     "tokens_per_tick": NUM,
     "decode_blocks": (dict,),
+    # mesh-sharded serving (docs/SERVING.md "Sharded serving"): the
+    # topology keys are ALWAYS present — {} / 1 / total-bytes on a
+    # single-device engine, so dashboards need no existence checks
+    "mesh_shape": (dict,),
+    "mesh_devices": (int,),
+    "cache_pool_bytes_per_device": (int,),
     # demo envelope
     "n_requests": (int,),
     "decode_compiles": (int,),
@@ -133,10 +139,13 @@ def main() -> None:
     env.setdefault("JAX_PLATFORMS", "cpu")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with tempfile.TemporaryDirectory() as tdir:
+        # --mesh makes the run exercise the SHARDED engine, so the gate
+        # also pins the mesh topology keys' populated form
         cmd = [
             sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4",
             "serve", "--demo", "--slots", "2",
             "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
+            "--mesh", "data=2,model=2",
             "--telemetry-dir", tdir,
         ]
         res = subprocess.run(
@@ -156,6 +165,19 @@ def main() -> None:
         except json.JSONDecodeError as e:
             fail(f"stdout line is not JSON: {e}")
         check_metrics_dict(stdout_metrics, "stdout")
+        if stdout_metrics.get("mesh_shape") != {"data": 2, "model": 2}:
+            fail(
+                "stdout: a --mesh data=2,model=2 run must report "
+                f"mesh_shape {{'data': 2, 'model': 2}}, got "
+                f"{stdout_metrics.get('mesh_shape')!r}"
+            )
+        if stdout_metrics.get("mesh_devices") != 4:
+            fail(
+                "stdout: mesh_devices must be 4 on a 2x2 mesh, got "
+                f"{stdout_metrics.get('mesh_devices')!r}"
+            )
+        if not stdout_metrics.get("cache_pool_bytes_per_device", 0) > 0:
+            fail("stdout: cache_pool_bytes_per_device must be positive")
 
         mpath = os.path.join(tdir, "metrics.json")
         if not os.path.exists(mpath):

@@ -87,6 +87,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "decode-block parity gate (fused blocks == generate(), every T)"
   python -m pytest tests/test_decode_block.py -q
 
+  step "sharded serving parity gate (mesh engine == generate(), 2x2)"
+  python -m pytest tests/test_serve_sharded.py -q
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
