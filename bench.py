@@ -118,6 +118,7 @@ _GROUPS = {
     "decode": ("decode",),
     "serve": ("serve",),
     "serve_sharded": ("serve_sharded",),
+    "serve_faults": ("serve_faults",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -823,6 +824,99 @@ def bench_serve(jax) -> dict:
     return {"serve": out}
 
 
+def bench_serve_faults(jax) -> dict:
+    """Fault-hook overhead proof + chaos throughput (docs/SERVING.md
+    "Failure semantics"): the resilience layer's contract is ZERO
+    overhead on the decode hot path when fault injection is disabled —
+    every hook is one ``is not None`` attribute check. Three figures:
+
+    - ``tokens_per_sec_disabled`` vs ``tokens_per_sec_disabled_repeat``
+      (two identical ``faults=None`` engines): the measurement's own
+      noise floor (``noise_pct``);
+    - ``tokens_per_sec_hooked``: an injector attached but with NO rates
+      and NO schedule, so every hook fires into an immediate miss —
+      bounds the cost of the hook machinery itself
+      (``hook_overhead_pct`` must sit inside the noise floor);
+    - a seeded chaos run (transient/oom/poison/stall rates through
+      ``run_demo``): throughput under fire plus the retry/quarantine/
+      degradation counters, proving faulted traffic still drains to
+      terminal statuses at speed."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve import FaultInjector, ServeEngine
+    from mmlspark_tpu.serve.demo import run_demo
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 32, 2, 2)
+    )
+    slots, n_req, max_new = (8, 8, 65) if full else (4, 4, 17)
+    p = 8
+    cache_len = 128 if full else 32
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len,
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, p), jnp.int32)
+    )
+    prompts = [
+        row.astype(np.int32)
+        for row in np.random.default_rng(11).integers(
+            0, vocab, size=(n_req, p)
+        )
+    ]
+
+    def timed_tps(injector) -> float:
+        engine = ServeEngine(
+            graph, variables, slots=slots, cache_len=cache_len,
+            max_queue=n_req, decode_block=16, faults=injector,
+        )
+
+        def drive():
+            for pr in prompts:
+                engine.submit(pr, max_new_tokens=max_new)
+            engine.run()
+
+        drive()  # warm-up: compiles the ladder once per engine
+        secs = min(_timed(drive) for _ in range(3))
+        return n_req * max_new / secs
+
+    tps_a = timed_tps(None)
+    tps_b = timed_tps(None)
+    # hooks live but guaranteed silent: empty schedule, no rates
+    tps_hooked = timed_tps(FaultInjector())
+    out: dict = {
+        "tokens_per_sec_disabled": round(tps_a, 1),
+        "tokens_per_sec_disabled_repeat": round(tps_b, 1),
+        "noise_pct": round(abs(tps_a / tps_b - 1) * 100, 2),
+        "tokens_per_sec_hooked": round(tps_hooked, 1),
+        "hook_overhead_pct": round((tps_a / tps_hooked - 1) * 100, 2),
+    }
+
+    chaos = run_demo(
+        slots=slots, n_requests=n_req * 2, max_new_tokens=max_new,
+        arrivals_per_tick=2, vocab=vocab, d_model=d_model, heads=heads,
+        depth=depth, cache_len=cache_len, seed=3,
+        faults="seed=7,transient=0.05,oom=0.03,poison=0.03,stall=0.02",
+    )
+    out["chaos"] = {
+        k: chaos.get(k)
+        for k in ("tokens_per_sec", "completed", "expired", "failed",
+                  "stalled", "retries_total", "faults_injected_total",
+                  "quarantined_total", "preemptions_total",
+                  "degraded_mode", "faults_by_kind", "decode_compiles",
+                  "prefill_compiles")
+    }
+    out["model"] = {"vocab": vocab, "d_model": d_model, "heads": heads,
+                    "depth": depth, "requests": n_req, "prompt": p,
+                    "max_new": max_new, "slots": slots}
+    out["timing"] = ("full ServeEngine drive per config, warm-up then "
+                     "best-of-3; chaos via run_demo at seeded rates")
+    return {"serve_faults": out}
+
+
 def bench_serve_sharded() -> dict:
     """Mesh-sharded serving scaling sweep (docs/SERVING.md "Sharded
     serving"): the SAME synthetic-traffic demo as the ``serve`` group,
@@ -1319,6 +1413,7 @@ def run(attempt: int) -> dict:
         "flash": lambda: bench_flash(jax, jnp),
         "decode": lambda: bench_decode(jax, jnp),
         "serve": lambda: bench_serve(jax),
+        "serve_faults": lambda: bench_serve_faults(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),

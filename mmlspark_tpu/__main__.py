@@ -79,6 +79,7 @@ def cmd_serve(args) -> int:
         decode_block=args.decode_block,
         mesh=args.mesh or None,
         telemetry_dir=args.telemetry_dir or None,
+        faults=args.faults or None,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -216,6 +217,15 @@ def main(argv: list[str] | None = None) -> int:
         help="write events.jsonl (per-request trace spans) and "
         "metrics.json (latency percentiles) under DIR "
         "(docs/OBSERVABILITY.md)",
+    )
+    sp.add_argument(
+        "--faults", default="", metavar="SPEC",
+        help="seeded chaos injection through the engine's fault hooks, "
+        "e.g. 'seed=7,transient=0.05,oom=0.02,poison=0.02': per-kind "
+        "fire rates plus 'seed' (required with rates) and 'stall_s'. "
+        "Faulted requests quarantine as status 'failed'; the run's "
+        "retry/quarantine/degradation counters land in the JSON line "
+        "(docs/OBSERVABILITY.md 'Fault injection')",
     )
     sp.set_defaults(fn=cmd_serve)
 

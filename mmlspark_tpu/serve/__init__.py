@@ -8,8 +8,21 @@ tick-based continuous-batching scheduler (:mod:`scheduler`), the public
 per-request deadlines (:mod:`engine`), serving observability as
 ``MetricData`` records (:mod:`metrics`), and a synthetic-traffic demo
 (:mod:`demo`, the ``python -m mmlspark_tpu serve`` body).
+
+The engine is fault-tolerant (docs/SERVING.md "Failure semantics"):
+transient dispatch errors retry, ``RESOURCE_EXHAUSTED`` degrades
+gracefully, poisoned/undispatachable requests quarantine with terminal
+status ``"failed"`` instead of killing ``run()``, and
+``ServeEngine.snapshot()``/``restore()`` checkpoint host-side request
+state for crash recovery. :class:`~mmlspark_tpu.core.faults.FaultInjector`
+(re-exported here) is the deterministic harness that proves all of it.
 """
 
+from mmlspark_tpu.core.faults import (  # noqa: F401
+    Fault,
+    FaultInjector,
+    parse_fault_spec,
+)
 from mmlspark_tpu.serve.cache_pool import SlotCachePool  # noqa: F401
 from mmlspark_tpu.serve.engine import ServeEngine  # noqa: F401
 from mmlspark_tpu.serve.metrics import ServeMetrics  # noqa: F401

@@ -27,12 +27,14 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              deadline_ticks: int | None = None,
              decode_block: int | None = None,
              mesh: str | None = None,
-             telemetry_dir: str | None = None) -> dict:
+             telemetry_dir: str | None = None,
+             faults: str | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line."""
     import jax
     import jax.numpy as jnp
 
+    from mmlspark_tpu.core.faults import parse_fault_spec
     from mmlspark_tpu.models import build_model
     from mmlspark_tpu.serve.engine import ServeEngine
 
@@ -49,6 +51,11 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         # "data=4,model=2"-style mesh spec -> the sharded engine
         # (docs/SERVING.md "Sharded serving"); None = single device
         mesh=mesh or None,
+        # "seed=7,transient=0.05,oom=0.02"-style fault spec -> seeded
+        # chaos injection (docs/OBSERVABILITY.md "Fault injection");
+        # None = no injector, hooks cost one attribute check
+        faults=parse_fault_spec(faults) if faults else None,
+        retry_backoff_s=0.0,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
     )

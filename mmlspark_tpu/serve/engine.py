@@ -51,6 +51,11 @@ import jax
 import jax.numpy as jnp
 
 from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.faults import (
+    FaultInjector,
+    is_resource_exhausted,
+    is_transient,
+)
 from mmlspark_tpu.core.telemetry import (
     FlightRecorder,
     RetraceWatchdog,
@@ -99,7 +104,11 @@ class ServeEngine:
                  cache_len: int | None = None, max_queue: int = 16,
                  pad_id: int = 0, decode_block: int = 32,
                  mesh=None,
-                 recorder: FlightRecorder | None = None):
+                 recorder: FlightRecorder | None = None,
+                 faults: FaultInjector | None = None,
+                 retry_limit: int = 3,
+                 retry_backoff_s: float = 0.02,
+                 degrade_recover_ticks: int = 8):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -182,6 +191,46 @@ class ServeEngine:
         self._sched = ContinuousBatchScheduler(self.pool,
                                                max_queue=max_queue)
         self._next_id = 0
+
+        # resilience layer (docs/SERVING.md "Failure semantics"):
+        # transient dispatch errors retry behind capped deterministic
+        # backoff; RESOURCE_EXHAUSTED steps down the decode-block
+        # ladder and caps admissions (graceful degradation — NO new XLA
+        # programs, the ladder sizes already exist); a request that
+        # still cannot make progress is QUARANTINED (terminal status
+        # "failed", slot freed, device live mask forced dead) instead
+        # of killing run(). ``faults`` is the deterministic injection
+        # harness (core/faults.py); None (the default) keeps every hook
+        # a single attribute check — zero work on the hot path.
+        if retry_limit < 0:
+            raise FriendlyError(
+                f"retry_limit must be >= 0, got {retry_limit}"
+            )
+        self._faults = faults
+        self._retry_limit = retry_limit
+        self._retry_backoff_s = retry_backoff_s
+        self._degrade_recover_ticks = max(1, degrade_recover_ticks)
+        #: memory-pressure degradation state: the current decode-block
+        #: ceiling (walks DOWN the existing power-of-two ladder on OOM,
+        #: re-escalates after ``degrade_recover_ticks`` clean ticks)
+        #: and the concurrent-admission cap
+        self._block_cap = self.decode_block
+        self._admit_cap = slots
+        self._ok_ticks = 0
+        #: vocab for token-stream validation (poison detection); None
+        #: when the builder records no vocab — validation then only
+        #: rejects negatives
+        self._vocab = graph.extra.get("vocab_size")
+        if self._faults is not None and self._faults.listener is None:
+            # injected faults land in the same metrics + event timeline
+            # as their consequences (retries, quarantines, degradation)
+            def _on_fault(kind: str, site: str) -> None:
+                self.metrics.record_fault(kind)
+                self.recorder.record(
+                    "fault_injected", tick=self.tick, kind=kind,
+                    site=site,
+                )
+            self._faults.listener = _on_fault
 
         # bucketed prefill: prompts are right-padded to power-of-two
         # length buckets, so the prefill program count is O(log
@@ -285,8 +334,10 @@ class ServeEngine:
         slots). Clamping to the min budget is the "shrink near budgets"
         parity rule: no slot can overrun its budget mid-block, so budget
         exhaustion only ever lands exactly on a block boundary (the only
-        mid-block death is EOS, which the on-device mask handles)."""
-        cap = min(self.decode_block, max(1, min_rem))
+        mid-block death is EOS, which the on-device mask handles).
+        Under memory-pressure degradation the ceiling is ``_block_cap``
+        (<= decode_block) — still on the ladder, so no new programs."""
+        cap = min(self._block_cap, max(1, min_rem))
         t = 1
         while t * 2 <= cap:
             t *= 2
@@ -300,6 +351,122 @@ class ServeEngine:
         to. Scan iterations inside a block share one program; only
         distinct static scan lengths compile separately."""
         return self.decode_block.bit_length()
+
+    # -- fault handling ----------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        """True while memory-pressure degradation holds the engine
+        below full service (reduced block ladder ceiling or admission
+        cap); the recovery probe clears it."""
+        return (
+            self._block_cap < self.decode_block
+            or self._admit_cap < self.pool.num_slots
+        )
+
+    def _backoff(self, attempts: int) -> None:
+        """Capped DETERMINISTIC backoff before a retry: linear in the
+        attempt number, no jitter — reproducibility is worth more to
+        this in-process engine than thundering-herd protection."""
+        self.metrics.record_retry()
+        self.recorder.record("retry", tick=self.tick, attempt=attempts)
+        if self._retry_backoff_s > 0:
+            time.sleep(self._retry_backoff_s * attempts)
+
+    def _note_oom(self, tick: int, site: str) -> None:
+        """Graceful degradation on RESOURCE_EXHAUSTED: step DOWN the
+        existing power-of-two decode-block ladder (never a new XLA
+        program) and tighten the admission cap; at the ladder floor,
+        preempt the youngest active request — its emitted tokens fold
+        into a resume prefix and it re-queues, so memory pressure costs
+        latency, not data. A recovery probe re-escalates after
+        ``degrade_recover_ticks`` clean ticks."""
+        if self._block_cap > 1:
+            self._block_cap //= 2
+        elif len(self._sched.active) > 1:
+            # youngest active slot: the most recently admitted request
+            # has the least sunk decode work to re-prefill on resume
+            slot = next(reversed(self._sched.active))
+            req = self._sched.preempt(slot)
+            self._sched.requeue(req)
+            self.metrics.record_preemption()
+            span = self._spans.get(req.id)
+            if span is not None:
+                span.event("preempted", tick=tick, slot=slot,
+                           prefix_len=len(req.prefix))
+            self.recorder.record(
+                "preempted", tick=tick, id=req.id, slot=slot,
+                prefix_len=len(req.prefix),
+            )
+        self._admit_cap = max(1, self._admit_cap - 1)
+        self._ok_ticks = 0
+        self.metrics.set_degraded(True)
+        self.recorder.record(
+            "degraded", tick=tick, site=site,
+            block_cap=self._block_cap, admit_cap=self._admit_cap,
+        )
+
+    def _note_clean_dispatch(self, tick: int) -> None:
+        """Recovery probe: after ``degrade_recover_ticks`` consecutive
+        clean decode dispatches, re-escalate one notch (block ladder
+        up one power of two, admission cap up one slot) — degradation
+        is a pressure response, not a ratchet."""
+        if not self.degraded:
+            return
+        self._ok_ticks += 1
+        if self._ok_ticks < self._degrade_recover_ticks:
+            return
+        self._ok_ticks = 0
+        self._block_cap = min(self.decode_block, self._block_cap * 2)
+        self._admit_cap = min(self.pool.num_slots, self._admit_cap + 1)
+        self.metrics.set_degraded(self.degraded)
+        self.recorder.record(
+            "recovered" if not self.degraded else "re_escalated",
+            tick=tick, block_cap=self._block_cap,
+            admit_cap=self._admit_cap,
+        )
+
+    def _token_ok(self, token: int) -> bool:
+        """Token-stream sanity: device-sampled greedy tokens are argmax
+        indices, so they are non-negative and < vocab — anything else
+        is corruption (e.g. an injected poison) and quarantines the
+        request before it can reach results or the KV frontier."""
+        if token < 0:
+            return False
+        return self._vocab is None or token < int(self._vocab)
+
+    def _quarantine_slot(self, slot: int, tick: int,
+                         reason: str) -> RequestResult:
+        """Retire one ACTIVE request as ``"failed"``: the slot frees
+        (device live mask forced dead, position zeroed — the row emits
+        pads and reads no KV until re-leased) and the engine keeps
+        serving everyone else."""
+        res = self._sched.fail(slot, tick)
+        self.metrics.record_quarantine()
+        span = self._spans.get(res.id)
+        if span is not None:
+            span.event("quarantined", tick=tick, slot=slot,
+                       reason=reason)
+        self.recorder.record(
+            "quarantine", tick=tick, id=res.id, slot=slot, reason=reason,
+        )
+        return res
+
+    def _quarantine_unactivated(self, req, slot: int, tick: int,
+                                reason: str) -> RequestResult:
+        """Retire a request whose prefill never succeeded (lease still
+        held by the admit loop) as ``"failed"``."""
+        self.pool.free(slot)
+        res = self._sched.fail_unactivated(req, tick)
+        self.metrics.record_quarantine()
+        span = self._spans.get(req.id)
+        if span is not None:
+            span.event("quarantined", tick=tick, slot=slot,
+                       reason=reason)
+        self.recorder.record(
+            "quarantine", tick=tick, id=req.id, slot=slot, reason=reason,
+        )
+        return res
 
     # -- introspection -----------------------------------------------------
 
@@ -356,6 +523,22 @@ class ServeEngine:
             raise FriendlyError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
             )
+        if int(prompt.size) >= self.cache_len:
+            # pointed admission error BEFORE the generic budget check:
+            # a prompt this long can never fit a single generated token
+            # in the slot buffers, whatever the budget
+            raise FriendlyError(
+                f"prompt length ({prompt.size}) must be < the engine's "
+                f"cache_len ({self.cache_len}); truncate the prompt or "
+                "build the engine with a larger cache_len"
+            )
+        if self._vocab is not None and prompt.size:
+            lo, hi = int(prompt.min()), int(prompt.max())
+            if lo < 0 or hi >= int(self._vocab):
+                raise FriendlyError(
+                    f"prompt tokens must be in [0, {self._vocab}) for "
+                    f"'{self.graph.name}', got range [{lo}, {hi}]"
+                )
         total = int(prompt.size) + max_new_tokens
         if total > self.cache_len:
             raise FriendlyError(
@@ -413,30 +596,84 @@ class ServeEngine:
         tokens_this_tick = 0
 
         with annotate("serve.admit"):
-            while self._sched.queue_depth and self.pool.free_count:
+            while (
+                self._sched.queue_depth
+                and self.pool.free_count
+                # admission cap: memory-pressure degradation admits
+                # fewer concurrent requests than the pool has slots
+                and self.pool.leased_count < self._admit_cap
+            ):
                 req = self._sched.pop_next()
                 slot = self.pool.lease()
                 span = self._spans.get(req.id)
                 if span is not None:
                     span.event("admitted", tick=tick, slot=slot)
+                # preempted/restored requests re-prefill prompt + the
+                # tokens already emitted: greedy determinism makes the
+                # resumed stream bit-identical to an uninterrupted one
+                seq = (
+                    np.concatenate([req.prompt, req.prefix])
+                    if len(req.prefix) else req.prompt
+                )
+                first = None
+                attempts = 0
                 with annotate("serve.prefill"):
-                    p = len(req.prompt)
+                    p = len(seq)
                     bucket = self.prefill_bucket(p)
                     padded = np.full((bucket,), self.pad_id, np.int32)
-                    padded[:p] = req.prompt
+                    padded[:p] = seq
                     tp = time.perf_counter()
-                    first, cache = self._prefill(
-                        self.variables, jnp.asarray(padded[None]), p - 1
+                    while True:
+                        try:
+                            if self._faults is not None:
+                                self._faults.fire(
+                                    "serve.prefill", tick=tick,
+                                    request=req.id,
+                                )
+                            first_d, cache = self._prefill(
+                                self.variables,
+                                jnp.asarray(padded[None]), p - 1,
+                            )
+                            # only the REAL prompt's K/V enter the
+                            # slot; the pad tail of the bucket cache is
+                            # dropped here
+                            self.pool.write_prefill(slot, cache, p)
+                            first = int(first_d[0])
+                            break
+                        except Exception as e:
+                            if is_resource_exhausted(e):
+                                self._note_oom(tick, "serve.prefill")
+                            elif not is_transient(e):
+                                raise
+                            attempts += 1
+                            if attempts > self._retry_limit:
+                                break
+                            self._backoff(attempts)
+                if first is None:
+                    # retries exhausted: quarantine THIS request only —
+                    # the admit loop moves on to the next joiner
+                    finished.append(self._quarantine_unactivated(
+                        req, slot, tick, "prefill_failed"
+                    ))
+                    continue
+                if self._faults is not None:
+                    poison = self._faults.poison_value(
+                        "serve.prefill", tick=tick, request=req.id
                     )
-                    # only the REAL prompt's K/V enter the slot; the pad
-                    # tail of the bucket cache is dropped here
-                    self.pool.write_prefill(slot, cache, p)
-                    first = int(first[0])
+                    if poison is not None:
+                        first = int(poison)
                 if span is not None:
                     span.event(
                         "prefill", tick=tick, bucket=bucket,
                         ms=round((time.perf_counter() - tp) * 1e3, 3),
                     )
+                if not self._token_ok(first):
+                    # corrupted first token: quarantine before it can
+                    # enter results or seed the decode frontier
+                    finished.append(self._quarantine_unactivated(
+                        req, slot, tick, "poisoned_token"
+                    ))
+                    continue
                 self.metrics.record_first_token(req, tick, bucket=bucket)
                 tokens_this_tick += 1
                 done = self._sched.activate(slot, req, first, tick)
@@ -449,11 +686,39 @@ class ServeEngine:
         leased_this_tick = self.pool.leased_count
 
         if self._sched.active:
+            tokens_this_tick += self._decode_phase(tick, finished)
+
+        self._sched.tick_count += 1
+        self.metrics.sample_tick(
+            self._sched.queue_depth, leased_this_tick,
+            time.perf_counter() - t0, tokens_emitted=tokens_this_tick,
+        )
+        for res in finished:
+            self.metrics.record_finish(res)
+            span = self._spans.pop(res.id, None)
+            if span is not None:
+                span.end(res.status, tick=res.finish_tick,
+                         generated=res.generated)
+        return finished
+
+    def _decode_phase(self, tick: int, finished: list) -> int:
+        """One fused decode BLOCK for all active slots, behind the
+        resilience layer: transient dispatch errors retry with capped
+        deterministic backoff, RESOURCE_EXHAUSTED degrades (smaller
+        ladder block, tighter admission, preemption at the floor) and
+        retries, and a dispatch that stays impossible quarantines the
+        remaining batch — every request gets a definite terminal status
+        instead of wedging ``run()``. Appends terminal results to
+        ``finished``; returns the real tokens consumed this tick."""
+        attempts = 0
+        while self._sched.active:
             n_active = len(self._sched.active)
             states = list(self._sched.active.items())
             # write positions BEFORE the block: consume() advances the
             # host mirrors, and the live-KV accounting below needs the
-            # per-slot starting frontier
+            # per-slot starting frontier. Rebuilt on every retry: an
+            # OOM response may have shrunk the block cap or preempted a
+            # slot since the failed attempt.
             pre_pos = {slot: st.pos for slot, st in states}
             tok, rem, eos, min_rem = self._sched.decode_block_inputs(
                 self.pad_id
@@ -471,26 +736,103 @@ class ServeEngine:
                 tok_d, rem_d, eos_d = (
                     jnp.asarray(tok), jnp.asarray(rem), jnp.asarray(eos)
                 )
-            with annotate("serve.decode"):
-                td = time.perf_counter()
-                toks, live, buffers, positions = self._decode(
-                    self.variables, self.pool.buffers,
-                    self.pool.positions, self.pool.live,
-                    tok_d, rem_d, eos_d, t_block,
+            try:
+                with annotate("serve.decode"):
+                    td = time.perf_counter()
+                    # the fault hook fires BEFORE the dispatch: an
+                    # injected failure never consumes the donated
+                    # buffers, so retrying with the same pool state is
+                    # always safe
+                    if self._faults is not None:
+                        self._faults.fire("serve.decode", tick=tick)
+                    toks, live, buffers, positions = self._decode(
+                        self.variables, self.pool.buffers,
+                        self.pool.positions, self.pool.live,
+                        tok_d, rem_d, eos_d, t_block,
+                    )
+                    # the inputs were DONATED: rebind the pool's device
+                    # state (buffers AND positions/live) to the block's
+                    # outputs before anything can touch stale references
+                    self.pool.buffers = buffers
+                    self.pool.positions = positions
+                    self.pool.live = live
+            except Exception as e:
+                if is_resource_exhausted(e):
+                    self._note_oom(tick, "serve.decode")
+                elif not is_transient(e):
+                    raise
+                attempts += 1
+                if attempts > self._retry_limit:
+                    # the batch stayed undispatchable through retries
+                    # AND degradation: quarantine what is left of it
+                    for slot, _st in states:
+                        if slot in self._sched.active:
+                            finished.append(self._quarantine_slot(
+                                slot, tick, "decode_failed"
+                            ))
+                    return 0
+                self._backoff(attempts)
+                continue
+
+            # the dispatch SUCCEEDED and the pool is rebound, so the
+            # fetch gets its OWN retry loop — re-dispatching here would
+            # decode past this block and skip its tokens
+            toks_h = live_h = None
+            fetch_attempts = 0
+            while True:
+                try:
+                    if self._faults is not None:
+                        self._faults.fire("serve.device_get", tick=tick)
+                    # the ONE host sync per block: (S, T) tokens + the
+                    # per-slot finished vector come back together
+                    toks_h, live_h = jax.device_get((toks, live))
+                    break
+                except Exception as e:
+                    if not (is_transient(e) or is_resource_exhausted(e)):
+                        raise
+                    fetch_attempts += 1
+                    if fetch_attempts > self._retry_limit:
+                        break
+                    self._backoff(fetch_attempts)
+            decode_s = time.perf_counter() - td
+            if toks_h is None:
+                # the block's tokens are unrecoverable on host: every
+                # active stream now has a gap — definite failure beats
+                # silently resuming with missing tokens
+                for slot, _st in states:
+                    if slot in self._sched.active:
+                        finished.append(self._quarantine_slot(
+                            slot, tick, "device_get_failed"
+                        ))
+                return 0
+
+            toks_h = np.asarray(toks_h)
+            if toks_h.ndim == 1:
+                toks_h = toks_h[:, None]
+            if self._faults is not None:
+                toks_h = self._faults.poison_block(
+                    "serve.device_get", toks_h, tick=tick,
+                    slots=[s for s, _ in states
+                           if s in self._sched.active],
                 )
-                # the inputs were DONATED: rebind the pool's device
-                # state (buffers AND positions/live) to the block's
-                # outputs before anything can touch stale references
-                self.pool.buffers = buffers
-                self.pool.positions = positions
-                self.pool.live = live
-                # the ONE host sync per block: (S, T) tokens + the
-                # per-slot finished vector come back together
-                toks_h, live_h = jax.device_get((toks, live))
-                decode_s = time.perf_counter() - td
+            # token-stream validation (always on — one vectorized pass
+            # over an (S, T) int block): greedy tokens are argmax
+            # indices in [0, vocab), so anything else is corruption;
+            # quarantine the row BEFORE consume() folds it into results
+            bad_rows = (toks_h < 0).any(axis=1)
+            if self._vocab is not None:
+                bad_rows |= (toks_h >= int(self._vocab)).any(axis=1)
+            quarantined: set[int] = set()
+            if bad_rows.any():
+                for slot, _st in states:
+                    if slot in self._sched.active and bad_rows[slot]:
+                        finished.append(self._quarantine_slot(
+                            slot, tick, "poisoned_token"
+                        ))
+                        quarantined.add(slot)
+
             blk_finished, consumed = self._sched.consume(toks_h, tick)
             n_tokens = sum(consumed.values())
-            tokens_this_tick += n_tokens
             # live KV rows the block actually attended, per slot: its
             # c consumed micro-steps read frontiers pos0+1 .. pos0+c
             # (an arithmetic series) — vs the c * cache_len rows a
@@ -506,8 +848,12 @@ class ServeEngine:
             if __debug__:
                 # the device live mask and the host's retirement
                 # bookkeeping must agree slot for slot — the parity
-                # contract's cheap runtime cross-check
+                # contract's cheap runtime cross-check (quarantined
+                # slots are exempt: the host retired them while the
+                # fetched mask still shows them live)
                 for slot, _st in states:
+                    if slot in quarantined:
+                        continue
                     assert bool(live_h[slot]) == (
                         slot in self._sched.active
                     ), (
@@ -523,25 +869,19 @@ class ServeEngine:
                                tokens=consumed.get(slot, 0),
                                step_ms=decode_ms)
             finished.extend(blk_finished)
-
-        self._sched.tick_count += 1
-        self.metrics.sample_tick(
-            self._sched.queue_depth, leased_this_tick,
-            time.perf_counter() - t0, tokens_emitted=tokens_this_tick,
-        )
-        for res in finished:
-            self.metrics.record_finish(res)
-            span = self._spans.pop(res.id, None)
-            if span is not None:
-                span.end(res.status, tick=res.finish_tick,
-                         generated=res.generated)
-        return finished
+            self._note_clean_dispatch(tick)
+            return n_tokens
+        return 0
 
     def run(self, max_ticks: int = 100_000) -> dict[int, RequestResult]:
         """Step until queue and slots drain; results keyed by request
         id. ``max_ticks`` bounds runaway loops (a generator that never
         emits EOS still retires at its token budget, so hitting the
-        bound means a caller bug — reported as the typed error)."""
+        bound means a caller bug — reported as the typed error). The
+        error does NOT discard work: completed results ride on it as
+        ``err.results``, alongside every still-pending request retired
+        with the definite status ``"stalled"`` — and the engine is
+        drained afterwards, not wedged."""
         results: dict[int, RequestResult] = {}
         start = self.tick
         # black-box contract: the flight recorder dumps its last N
@@ -550,11 +890,126 @@ class ServeEngine:
         with self.recorder.dump_on_friendly_error():
             while self._sched.busy:
                 if self.tick - start >= max_ticks:
-                    raise FriendlyError(
+                    n_queued = self._sched.queue_depth
+                    n_active = len(self._sched.active)
+                    for res in self._sched.stall_pending(self.tick):
+                        results[res.id] = res
+                        self.metrics.record_finish(res)
+                        span = self._spans.pop(res.id, None)
+                        if span is not None:
+                            span.end(res.status, tick=res.finish_tick,
+                                     generated=res.generated)
+                    err = FriendlyError(
                         f"serve run() exceeded max_ticks ({max_ticks}) "
-                        f"with {self._sched.queue_depth} queued and "
-                        f"{len(self._sched.active)} active requests"
+                        f"with {n_queued} queued and "
+                        f"{n_active} active requests; partial results "
+                        "(completed + 'stalled') are attached as "
+                        "err.results"
                     )
+                    err.results = results
+                    raise err
                 for res in self.step():
                     results[res.id] = res
         return results
+
+    # -- checkpoint / restore ----------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able checkpoint of ALL host-side request state: every
+        queued and active request's prompt, emitted tokens, budget,
+        deadline, and the engine tick. Deliberately NO device state —
+        restore re-prefills prompt + emitted prefix, and greedy decode
+        makes the rebuilt KV frontier (and every post-restore token)
+        bit-identical to the uncrashed run, so the checkpoint stays
+        tiny and device-layout-agnostic (a single-device snapshot
+        restores onto a mesh engine, and vice versa). Call between
+        ``step()``s; hand the dict to :meth:`restore` after a crash."""
+        active = []
+        for slot, st in sorted(self._sched.active.items()):
+            req = st.req
+            active.append({
+                "id": req.id,
+                "prompt": [int(x) for x in req.prompt],
+                "emitted": [int(x) for x in st.out],
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "deadline_tick": req.deadline_tick,
+                "submit_tick": req.submit_tick,
+            })
+        queued = []
+        for req in self._sched.queue:
+            queued.append({
+                "id": req.id,
+                "prompt": [int(x) for x in req.prompt],
+                "emitted": [int(x) for x in req.prefix],
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "deadline_tick": req.deadline_tick,
+                "submit_tick": req.submit_tick,
+            })
+        return {
+            "version": 1,
+            "model": self.graph.name,
+            "cache_len": self.cache_len,
+            "pad_id": self.pad_id,
+            "tick": self.tick,
+            "next_id": self._next_id,
+            "active": active,
+            "queued": queued,
+        }
+
+    @classmethod
+    def restore(cls, snapshot: dict, graph, variables,
+                **kwargs) -> "ServeEngine":
+        """Rebuild a crashed engine from :meth:`snapshot`: a fresh
+        engine (same graph/variables; ``kwargs`` as for the
+        constructor) whose queue re-admits every checkpointed request —
+        active ones first, carrying their emitted tokens as a resume
+        prefix, so re-prefilling prompt + prefix continues each stream
+        bit-identically (the crash drill in tests/test_serve_faults.py
+        is the proof). Deadlines and the tick counter are absolute and
+        survive the rebuild."""
+        if snapshot.get("version") != 1:
+            raise FriendlyError(
+                f"unknown serve snapshot version "
+                f"{snapshot.get('version')!r} (this build reads "
+                "version 1)"
+            )
+        if snapshot.get("model") != graph.name:
+            raise FriendlyError(
+                f"snapshot is for model {snapshot.get('model')!r}, "
+                f"cannot restore onto {graph.name!r}"
+            )
+        kwargs.setdefault("cache_len", snapshot["cache_len"])
+        kwargs.setdefault("pad_id", snapshot["pad_id"])
+        engine = cls(graph, variables, **kwargs)
+        engine._sched.tick_count = int(snapshot["tick"])
+        engine._next_id = int(snapshot["next_id"])
+        now = time.perf_counter()
+        # active requests resume FIRST (they were running when the
+        # engine died), then the queued ones in their original order —
+        # appended directly, bypassing max_queue: these were already
+        # admitted once, bouncing them now would turn a crash into
+        # data loss
+        for entry in list(snapshot["active"]) + list(snapshot["queued"]):
+            req = ServeRequest(
+                id=int(entry["id"]),
+                prompt=np.asarray(entry["prompt"], np.int32),
+                max_new_tokens=int(entry["max_new_tokens"]),
+                eos_id=entry["eos_id"],
+                deadline_tick=entry["deadline_tick"],
+                submit_tick=int(entry["submit_tick"]),
+                submit_wall=now,
+                prefix=np.asarray(entry.get("emitted", ()), np.int32),
+            )
+            engine._sched.queue.append(req)
+            engine.metrics.record_submit()
+            span = engine._tracer.span(
+                "request", tick=engine.tick, id=req.id,
+                prompt_len=int(req.prompt.size),
+                max_new_tokens=req.max_new_tokens,
+            )
+            span.event("restored", tick=engine.tick,
+                       prefix_len=len(req.prefix))
+            engine._spans[req.id] = span
+        return engine

@@ -64,8 +64,23 @@ class ServeMetrics:
         self._rejected = r.counter("serve.rejected")
         self._completed = r.counter("serve.completed")
         self._expired = r.counter("serve.expired")
+        self._failed = r.counter("serve.failed")
+        self._stalled = r.counter("serve.stalled")
         self._tokens_generated = r.counter("serve.tokens_generated")
         self._prefills = r.counter("serve.prefills")
+        # resilience plane (docs/SERVING.md "Failure semantics"):
+        # injected faults, retry absorptions, quarantines, preemptions
+        self._retries = r.counter("serve.retries")
+        self._faults_injected = r.counter("serve.faults_injected")
+        self._quarantined = r.counter("serve.quarantined")
+        self._preemptions = r.counter("serve.preemptions")
+        #: 1 while the engine runs below its configured decode-block
+        #: ladder top or admission cap (memory-pressure degradation),
+        #: 0 once the recovery probe has re-escalated to full service
+        self.degraded_mode = 0
+        #: injected-fault count per kind (mirrors the injector's own
+        #: ``counts``; rides to_dict as a table like prefill_buckets)
+        self.faults_by_kind: dict[str, int] = {}
         self._ttft_ms = r.histogram("serve.ttft_ms")
         self._per_token_ms = r.histogram("serve.per_token_ms")
         self._tick_ms = r.histogram("serve.tick_ms")
@@ -108,6 +123,30 @@ class ServeMetrics:
     @property
     def expired(self) -> int:
         return self._expired.value
+
+    @property
+    def failed(self) -> int:
+        return self._failed.value
+
+    @property
+    def stalled(self) -> int:
+        return self._stalled.value
+
+    @property
+    def retries_total(self) -> int:
+        return self._retries.value
+
+    @property
+    def faults_injected_total(self) -> int:
+        return self._faults_injected.value
+
+    @property
+    def quarantined_total(self) -> int:
+        return self._quarantined.value
+
+    @property
+    def preemptions_total(self) -> int:
+        return self._preemptions.value
 
     @property
     def tokens_generated(self) -> int:
@@ -172,10 +211,35 @@ class ServeMetrics:
     def record_finish(self, result) -> None:
         if result.status == "expired":
             self._expired.inc()
+        elif result.status == "failed":
+            self._failed.inc()
+        elif result.status == "stalled":
+            self._stalled.inc()
         else:
             self._completed.inc()
         self._tokens_generated.inc(result.generated)
         self._touch()
+
+    def record_fault(self, kind: str) -> None:
+        """One injected fault (the injector's listener calls this)."""
+        self._faults_injected.inc()
+        self.faults_by_kind[kind] = self.faults_by_kind.get(kind, 0) + 1
+
+    def record_retry(self) -> None:
+        """One dispatch retry the backoff loop absorbed."""
+        self._retries.inc()
+
+    def record_quarantine(self) -> None:
+        """One request retired as ``"failed"`` by fault handling."""
+        self._quarantined.inc()
+
+    def record_preemption(self) -> None:
+        """One active request evicted + requeued under memory
+        pressure."""
+        self._preemptions.inc()
+
+    def set_degraded(self, degraded: bool) -> None:
+        self.degraded_mode = int(degraded)
 
     def sample_tick(self, queue_depth: int, leased: int, seconds: float,
                     tokens_emitted: int = 0) -> None:
@@ -212,6 +276,8 @@ class ServeMetrics:
             "rejected": self.rejected,
             "completed": self.completed,
             "expired": self.expired,
+            "failed": self.failed,
+            "stalled": self.stalled,
             "tokens_generated": self.tokens_generated,
             "queue_depth_mean": _mean(self.queue_depth_samples),
             "queue_depth_max": (
@@ -271,6 +337,15 @@ class ServeMetrics:
             "mesh_shape": dict(self.mesh_shape),
             "mesh_devices": self.mesh_devices,
             "cache_pool_bytes_per_device": self.cache_pool_bytes_per_device,
+            # resilience plane (docs/SERVING.md "Failure semantics";
+            # schema-gated): fault-handling activity and whether the
+            # engine is currently degraded
+            "retries_total": self.retries_total,
+            "faults_injected_total": self.faults_injected_total,
+            "quarantined_total": self.quarantined_total,
+            "preemptions_total": self.preemptions_total,
+            "degraded_mode": self.degraded_mode,
+            "faults_by_kind": dict(self.faults_by_kind),
         }
 
     def snapshot(self) -> list[MetricData]:

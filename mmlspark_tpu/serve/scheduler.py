@@ -21,6 +21,7 @@ is where — FIFO queue, per-slot decode state, deadline expiry.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -28,6 +29,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
+
+_EMPTY_PREFIX = np.zeros(0, np.int32)
 
 
 @dataclass(frozen=True)
@@ -44,14 +47,24 @@ class ServeRequest:
     deadline_tick: int | None
     submit_tick: int
     submit_wall: float
+    #: tokens ALREADY generated for this request before (re)admission —
+    #: non-empty only for preempted/restored requests, whose activation
+    #: re-prefills prompt + prefix so decode resumes exactly where it
+    #: stopped (greedy determinism keeps the stream bit-identical).
+    #: Counts against ``max_new_tokens``.
+    prefix: np.ndarray = field(default_factory=lambda: _EMPTY_PREFIX)
 
 
 @dataclass
 class RequestResult:
     """Terminal record for one request: ``status`` is ``"completed"``
-    (budget or EOS reached) or ``"expired"`` (deadline passed while
-    queued or mid-decode — ``tokens`` then carries whatever was
-    generated). ``tokens`` includes the prompt, like ``generate()``."""
+    (budget or EOS reached), ``"expired"`` (deadline passed while
+    queued or mid-decode), ``"failed"`` (quarantined by the engine's
+    fault handling — a poisoned token stream or a dispatch failure that
+    retries could not absorb), or ``"stalled"`` (``run()`` hit its
+    ``max_ticks`` bound with the request still pending). For every
+    non-completed status ``tokens`` carries whatever was generated.
+    ``tokens`` includes the prompt, like ``generate()``."""
 
     id: int
     status: str
@@ -119,10 +132,7 @@ class ContinuousBatchScheduler:
         kept: deque[ServeRequest] = deque()
         for req in self.queue:
             if req.deadline_tick is not None and tick >= req.deadline_tick:
-                out.append(self._result(
-                    req, "expired", tokens=req.prompt, generated=0,
-                    first_token_tick=None, tick=tick,
-                ))
+                out.append(self._queued_result(req, "expired", tick))
             else:
                 kept.append(req)
         self.queue = kept
@@ -138,13 +148,17 @@ class ContinuousBatchScheduler:
                  tick: int) -> RequestResult | None:
         """Install a prefilled request into its slot. Returns a terminal
         result immediately when the FIRST token already finishes it
-        (max_new_tokens == 1, or the first token is EOS) — the slot is
-        freed without ever joining the decode batch."""
-        st = _SlotState(req=req, pos=len(req.prompt),
-                        last_token=first_token, out=[first_token],
+        (the token budget is reached, or the token is EOS) — the slot is
+        freed without ever joining the decode batch. A request carrying
+        a ``prefix`` (preempted or restored) was prefilled over prompt +
+        prefix, so its decode frontier starts past the prefix and the
+        prefix counts against the budget."""
+        st = _SlotState(req=req, pos=len(req.prompt) + len(req.prefix),
+                        last_token=first_token,
+                        out=list(req.prefix) + [first_token],
                         first_token_tick=tick)
         if (
-            req.max_new_tokens == 1
+            len(st.out) >= req.max_new_tokens
             or (req.eos_id is not None and first_token == req.eos_id)
         ):
             self.pool.free(slot)
@@ -221,7 +235,70 @@ class ContinuousBatchScheduler:
             consumed[slot] = taken
         return finished, consumed
 
+    # -- fault handling (engine.py's resilience layer calls these) ---------
+
+    def fail(self, slot: int, tick: int) -> RequestResult:
+        """Quarantine one ACTIVE request: pop it, free its slot (which
+        forces the device live mask dead and the position to 0, so the
+        row emits pads and reads no KV until re-leased), and retire it
+        with the definite terminal status ``"failed"`` — the blast
+        radius of a poisoned or undispatachable request is that request,
+        never ``run()``."""
+        st = self.active.pop(slot)
+        self.pool.free(slot)
+        return self._finish(st, "failed", tick)
+
+    def fail_unactivated(self, req: ServeRequest,
+                         tick: int) -> RequestResult:
+        """Quarantine a request whose prefill never succeeded (its slot
+        is freed by the caller, which still holds the lease)."""
+        return self._queued_result(req, "failed", tick)
+
+    def preempt(self, slot: int) -> ServeRequest:
+        """Evict one ACTIVE request under memory pressure, folding its
+        emitted tokens into a resume ``prefix`` so re-admission
+        re-prefills prompt + prefix and continues bit-identically. The
+        slot is freed; the caller requeues the returned request."""
+        st = self.active.pop(slot)
+        self.pool.free(slot)
+        return dataclasses.replace(
+            st.req, prefix=np.asarray(st.out, np.int32)
+        )
+
+    def requeue(self, req: ServeRequest) -> None:
+        """Put a preempted request back at the FRONT of the queue,
+        bypassing the ``max_queue`` bound — preemption moves a request
+        the engine already accepted; bouncing it off admission control
+        would turn backpressure into data loss."""
+        self.queue.appendleft(req)
+
+    def stall_pending(self, tick: int) -> list[RequestResult]:
+        """Retire EVERY still-pending request (queued and active) with
+        the definite terminal status ``"stalled"`` — ``run()``'s
+        ``max_ticks`` bound calls this so no request is ever silently
+        discarded."""
+        out: list[RequestResult] = []
+        while self.queue:
+            out.append(self._queued_result(
+                self.queue.popleft(), "stalled", tick
+            ))
+        for slot, st in sorted(self.active.items()):
+            self.pool.free(slot)
+            out.append(self._finish(st, "stalled", tick))
+        self.active.clear()
+        return out
+
     # -- result assembly ---------------------------------------------------
+
+    def _queued_result(self, req: ServeRequest, status: str,
+                       tick: int) -> RequestResult:
+        """Terminal record for a request that never (re)activated —
+        its tokens are the prompt plus any resume prefix."""
+        return self._result(
+            req, status,
+            tokens=np.concatenate([req.prompt, req.prefix]),
+            generated=len(req.prefix), first_token_tick=None, tick=tick,
+        )
 
     def _finish(self, st: _SlotState, status: str,
                 tick: int) -> RequestResult:

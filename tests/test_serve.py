@@ -176,6 +176,88 @@ def test_deadline_expiry_in_queue():
     assert engine.metrics.expired == 1 and engine.metrics.completed == 1
 
 
+def test_run_max_ticks_attaches_partial_results():
+    """``run(max_ticks=N)`` overrunning must not DISCARD the finished
+    work: the raised FriendlyError carries ``err.results`` with every
+    completed request plus the pending ones retired as ``"stalled"``,
+    and the engine is left drained (not busy, pool empty)."""
+    m = _tiny()
+    v, ids = _train_lm(m, steps=5)
+    engine = ServeEngine(m, v, slots=1, cache_len=32, max_queue=4,
+                         decode_block=1)
+    rid_short = engine.submit(np.asarray(ids[0, :4]), max_new_tokens=2)
+    rid_long = engine.submit(np.asarray(ids[0, :5]), max_new_tokens=20)
+    with pytest.raises(FriendlyError, match="stalled") as ei:
+        engine.run(max_ticks=4)
+    results = ei.value.results
+    assert results[rid_short].status == "completed"
+    assert results[rid_short].generated == 2
+    assert results[rid_long].status == "stalled"
+    # partial progress travels with the stalled result
+    assert 0 < results[rid_long].generated < 20
+    assert engine.metrics.stalled == 1 and engine.metrics.completed == 1
+    assert not engine.busy and engine.pool.leased_count == 0
+    # the drained engine is still serviceable
+    rid2 = engine.submit(np.asarray(ids[0, :4]), max_new_tokens=2)
+    assert engine.run()[rid2].status == "completed"
+
+
+def test_expire_active_slot_forces_device_state_dead():
+    """Expiring an ACTIVE request must kill its device-side row — live
+    mask False, position 0 — immediately, so the fused decode spends no
+    flash-decode KV traffic on a corpse and the slot is re-leasable."""
+    m = _tiny()
+    v, ids = _train_lm(m, steps=5)
+    engine = ServeEngine(m, v, slots=2, cache_len=32, max_queue=4,
+                         decode_block=1)
+    prompt_b = np.asarray(ids[0, :5])
+    ref_b = generate(m, v, prompt_b[None], 10)[0]
+    rid_a = engine.submit(np.asarray(ids[0, :4]), max_new_tokens=12,
+                          deadline_ticks=2)
+    rid_b = engine.submit(prompt_b, max_new_tokens=10)
+    results = {r.id: r for r in engine.step()}  # tick 0: both admitted
+    slot_a = next(s for s, st in engine._sched.active.items()
+                  if st.req.id == rid_a)
+    while rid_a not in results:
+        results.update({r.id: r for r in engine.step()})
+    assert results[rid_a].status == "expired"
+    # the expired row is dead ON DEVICE, mid-run, with B still active
+    assert not bool(np.asarray(jax.device_get(engine.pool.live))[slot_a])
+    assert int(np.asarray(jax.device_get(
+        engine.pool.positions))[slot_a]) == 0
+    assert any(st.req.id == rid_b
+               for st in engine._sched.active.values())
+    # the freed slot re-leases cleanly while B keeps decoding
+    rid_c = engine.submit(np.asarray(ids[0, :6]), max_new_tokens=4)
+    results.update(engine.run())
+    assert results[rid_b].status == "completed"
+    np.testing.assert_array_equal(np.asarray(results[rid_b].tokens),
+                                  np.asarray(ref_b))
+    assert results[rid_c].status == "completed"
+
+
+def test_expired_slot_releases_same_tick():
+    """The slot freed by an active-request expiry is safe to re-lease
+    in the SAME tick: the replacement prefills into it immediately and
+    its stream matches ``generate()`` (no stale KV bleed-through)."""
+    m = _tiny()
+    v, ids = _train_lm(m, steps=5)
+    engine = ServeEngine(m, v, slots=1, cache_len=32, max_queue=4,
+                         decode_block=1)
+    prompt_b = np.asarray(ids[0, :5])
+    ref_b = generate(m, v, prompt_b[None], 6)[0]
+    rid_a = engine.submit(np.asarray(ids[0, :4]), max_new_tokens=12,
+                          deadline_ticks=2)
+    rid_b = engine.submit(prompt_b, max_new_tokens=6)  # waits for the slot
+    results = engine.run()
+    assert results[rid_a].status == "expired"
+    assert results[rid_b].status == "completed"
+    # B entered the slot on the very tick A expired out of it
+    assert results[rid_b].first_token_tick == results[rid_a].finish_tick
+    np.testing.assert_array_equal(np.asarray(results[rid_b].tokens),
+                                  np.asarray(ref_b))
+
+
 def test_queue_full_raises_typed_error():
     m = _tiny()
     v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
@@ -201,6 +283,21 @@ def test_submit_validation():
     with pytest.raises(FriendlyError, match="deadline_ticks"):
         engine.submit(np.ones(4, np.int32), max_new_tokens=2,
                       deadline_ticks=0)
+    with pytest.raises(FriendlyError, match="non-empty"):
+        engine.submit(np.zeros(0, np.int32), max_new_tokens=2)
+    with pytest.raises(FriendlyError, match="max_new_tokens"):
+        engine.submit(np.ones(4, np.int32), max_new_tokens=-3)
+    # a prompt >= cache_len gets the POINTED admission error (it could
+    # never fit a single generated token, whatever the budget)
+    with pytest.raises(FriendlyError, match="truncate the prompt"):
+        engine.submit(np.ones(16, np.int32), max_new_tokens=1)
+    # out-of-vocab prompt tokens are rejected at submit, not at decode
+    with pytest.raises(FriendlyError, match=r"in \[0, 8\)"):
+        engine.submit(np.full(4, 99, np.int32), max_new_tokens=2)
+    with pytest.raises(FriendlyError, match=r"in \[0, 8\)"):
+        engine.submit(np.asarray([1, -2, 3], np.int32), max_new_tokens=2)
+    # nothing above leaked into the accounting
+    assert engine.metrics.submitted == 0 and not engine.busy
 
 
 def test_engine_build_guards():

@@ -214,6 +214,45 @@ def test_sharded_one_host_sync_per_block(lm, monkeypatch):
     assert syncs["n"] <= 2, f"host syncs: {syncs['n']} (> 1 per block)"
 
 
+# -- expiry under the mesh: device row dies, slot re-leases ----------------
+
+
+def test_sharded_expire_active_slot_device_state(lm):
+    """The expire-active regression on a 2x2 mesh: an ACTIVE request
+    expiring mid-decode leaves its sharded live-mask row dead and its
+    position zeroed, the survivor keeps byte parity, and the freed slot
+    re-leases cleanly in the same run."""
+    m, v, ids = lm
+    engine = ServeEngine(m, v, slots=2, cache_len=32, max_queue=4,
+                         decode_block=1, mesh={"data": 2, "model": 2})
+    prompt_b = np.asarray(ids[0, :5])
+    rid_a = engine.submit(np.asarray(ids[0, :4]), max_new_tokens=12,
+                          deadline_ticks=2)
+    rid_b = engine.submit(prompt_b, max_new_tokens=10)
+    results = {r.id: r for r in engine.step()}  # tick 0: both admitted
+    slot_a = next(s for s, st in engine._sched.active.items()
+                  if st.req.id == rid_a)
+    while rid_a not in results:
+        results.update({r.id: r for r in engine.step()})
+    assert results[rid_a].status == "expired"
+    # the sharded (data-axis-split) pool state agrees: row dead, pos 0
+    assert not bool(np.asarray(jax.device_get(engine.pool.live))[slot_a])
+    assert int(np.asarray(jax.device_get(
+        engine.pool.positions))[slot_a]) == 0
+    # re-lease the freed slot under the mesh while B keeps decoding
+    rid_c = engine.submit(np.asarray(ids[0, :6]), max_new_tokens=4)
+    results.update(engine.run())
+    assert results[rid_b].status == "completed"
+    np.testing.assert_array_equal(
+        np.asarray(results[rid_b].tokens), _ref(m, v, prompt_b, 10)
+    )
+    assert results[rid_c].status == "completed"
+    np.testing.assert_array_equal(
+        np.asarray(results[rid_c].tokens),
+        _ref(m, v, np.asarray(ids[0, :6]), 4),
+    )
+
+
 # -- telemetry: mesh topology in the metrics surfaces ----------------------
 
 

@@ -77,6 +77,18 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "mesh_shape": (dict,),
     "mesh_devices": (int,),
     "cache_pool_bytes_per_device": (int,),
+    # resilience plane (docs/SERVING.md "Failure semantics"): terminal
+    # statuses beyond completed/expired plus the fault-handling
+    # counters — always present (0 on a fault-free run) so dashboards
+    # can alert on them without existence checks
+    "failed": (int,),
+    "stalled": (int,),
+    "retries_total": (int,),
+    "faults_injected_total": (int,),
+    "quarantined_total": (int,),
+    "preemptions_total": (int,),
+    "degraded_mode": (int,),
+    "faults_by_kind": (dict,),
     # demo envelope
     "n_requests": (int,),
     "decode_compiles": (int,),
@@ -128,7 +140,7 @@ def check_events(path: str, n_requests: int) -> int:
         missing = {"queued", "admitted", "prefill"} - set(names)
         if missing:
             fail(f"span {sid} lacks lifecycle events {missing}: {names}")
-        if names[-1] not in ("completed", "expired"):
+        if names[-1] not in ("completed", "expired", "failed", "stalled"):
             fail(f"span {sid} never reached a terminal status: {names}")
     return len(lines)
 

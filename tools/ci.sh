@@ -90,6 +90,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "sharded serving parity gate (mesh engine == generate(), 2x2)"
   python -m pytest tests/test_serve_sharded.py -q
 
+  step "serving resilience gate (fault injection / quarantine / chaos soak)"
+  python -m pytest tests/test_serve_faults.py -q
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
