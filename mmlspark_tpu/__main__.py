@@ -80,6 +80,8 @@ def cmd_serve(args) -> int:
         mesh=args.mesh or None,
         telemetry_dir=args.telemetry_dir or None,
         faults=args.faults or None,
+        slo=args.slo or None,
+        trace_out=args.trace_out or None,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -214,9 +216,26 @@ def main(argv: list[str] | None = None) -> int:
     )
     sp.add_argument(
         "--telemetry-dir", default="", metavar="DIR",
-        help="write events.jsonl (per-request trace spans) and "
-        "metrics.json (latency percentiles) under DIR "
-        "(docs/OBSERVABILITY.md)",
+        help="write events.jsonl (per-request trace spans), "
+        "metrics.json (latency percentiles), trace.json (Perfetto-"
+        "loadable Chrome trace), and metrics.prom (Prometheus text "
+        "exposition) under DIR (docs/OBSERVABILITY.md)",
+    )
+    sp.add_argument(
+        "--trace-out", default="", metavar="PATH",
+        help="write the run's Chrome trace-event JSON to PATH — open "
+        "it at ui.perfetto.dev: one track per request, tick + program-"
+        "dispatch tracks (docs/OBSERVABILITY.md 'Trace export')",
+    )
+    sp.add_argument(
+        "--slo", default="", metavar="SPEC",
+        help="declare rolling-window SLOs, e.g. 'ttft_p99_ms=50,"
+        "per_token_p99_ms=5,error_rate=0.05,window_s=30': burning a "
+        "target emits slo_violation flight-recorder alerts and SHEDS "
+        "LOAD (new admissions pause until the window recovers); the "
+        "JSON line grows slo_burning / slo_violations_total / "
+        "slo_shed_ticks_total and the full window state under 'slo' "
+        "(docs/OBSERVABILITY.md 'Declaring SLOs')",
     )
     sp.add_argument(
         "--faults", default="", metavar="SPEC",

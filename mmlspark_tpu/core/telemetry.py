@@ -156,7 +156,32 @@ class Histogram:
     def mean(self) -> float | None:
         return (self.sum / self.count) if self.count else None
 
+    def bucket_bounds(self) -> list[float | str]:
+        """Upper edge of each bucket, aligned with :meth:`bucket_counts`.
+        Bucket 0's edge is ``lo`` (values ``<= lo``), the overflow
+        bucket's is the string ``"+Inf"`` (JSON has no Infinity; the
+        spelling matches Prometheus' ``le`` label)."""
+        edges: list[float | str] = [self.lo]
+        for i in range(1, self.n_buckets - 1):
+            edges.append(self.lo * self.growth ** i)
+        edges.append("+Inf")
+        return edges
+
+    def bucket_counts(self) -> list[int]:
+        """Per-bucket observation counts (NOT cumulative), aligned with
+        :meth:`bucket_bounds`."""
+        return list(self._counts)
+
     def summary(self) -> dict:
+        # buckets export only the OCCUPIED range (trailing empties after
+        # the last non-zero are dropped, leading empties kept so edges
+        # still align by index) — a default histogram has ~530 bins and
+        # dashboards only want the populated ones
+        last = 0
+        for i, c in enumerate(self._counts):
+            if c:
+                last = i + 1
+        bounds = self.bucket_bounds()[:last]
         return {
             "count": self.count,
             "sum": round(self.sum, 6),
@@ -166,6 +191,13 @@ class Histogram:
             "p50": self.percentile(50),
             "p95": self.percentile(95),
             "p99": self.percentile(99),
+            "buckets": {
+                "bounds": [
+                    b if isinstance(b, str) else round(b, 9)
+                    for b in bounds
+                ],
+                "counts": self._counts[:last],
+            },
         }
 
 
@@ -223,6 +255,44 @@ class MetricRegistry:
                 out[name] = m.value
         return out
 
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) for live scraping.
+
+        Dotted metric names become underscore-separated
+        (``serve.ttft_ms`` -> ``serve_ttft_ms``); counters get the
+        conventional ``_total`` suffix; histograms emit CUMULATIVE
+        ``_bucket{le="..."}`` series (one per occupied log-bucket edge
+        plus ``+Inf``) with ``_sum`` and ``_count`` — real
+        distributions, not three precomputed quantiles
+        (docs/OBSERVABILITY.md "Prometheus scraping")."""
+        out: list[str] = []
+        for name in self.names():
+            m = self._metrics[name]
+            pname = _prom_name(name)
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {pname}_total counter")
+                out.append(f"{pname}_total {m.value}")
+            elif isinstance(m, Gauge):
+                if m.value is None:
+                    continue
+                out.append(f"# TYPE {pname} gauge")
+                out.append(f"{pname} {_prom_num(m.value)}")
+            elif isinstance(m, Histogram):
+                out.append(f"# TYPE {pname} histogram")
+                cum = 0
+                bounds = m.bucket_bounds()
+                for edge, c in zip(bounds, m.bucket_counts()):
+                    cum += c
+                    if c == 0 and edge != "+Inf":
+                        continue  # occupied edges + +Inf keep it short
+                    le = edge if isinstance(edge, str) else _prom_num(edge)
+                    out.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+                if bounds[-1] != "+Inf" or not m.bucket_counts():
+                    out.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
+                out.append(f"{pname}_sum {_prom_num(m.sum)}")
+                out.append(f"{pname}_count {m.count}")
+        return "\n".join(out) + ("\n" if out else "")
+
     def snapshot(self, model: str | None = None,
                  group: str | None = None) -> list[MetricData]:
         """Structured records: scalars via ``MetricData.create``-style
@@ -236,6 +306,24 @@ class MetricRegistry:
                 out.append(MetricData(name=name, value=float(m.value),
                                       model=model, group=group))
         return out
+
+
+def _prom_name(name: str) -> str:
+    """Registry names are dotted; Prometheus names are
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    cleaned = "".join(
+        c if c.isalnum() or c in "_:" else "_" for c in name
+    )
+    if cleaned and cleaned[0].isdigit():
+        cleaned = "_" + cleaned
+    return cleaned or "_"
+
+
+def _prom_num(value: float) -> str:
+    """Shortest faithful rendering: integers without the trailing
+    ``.0``, floats via repr (round-trippable)."""
+    f = float(value)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
 
 
 _DEFAULT_REGISTRY = MetricRegistry()
@@ -269,6 +357,14 @@ class FlightRecorder:
         self._events: deque[dict] = deque(maxlen=capacity)
         self.dropped = 0
         self._lock = threading.Lock()
+        # wall-clock anchor: unix epoch seconds at monotonic zero, so
+        # any event's absolute time is t0_unix + ev["t"]. Events keep
+        # carrying ONLY monotonic seconds (cheap, ordering-safe); the
+        # anchor is stamped once here and exported by dump() headers and
+        # trace exports, which is what lets events.jsonl from different
+        # processes — or an engine restored from a snapshot — be
+        # correlated on one timeline.
+        self.t0_unix = time.time() - time.monotonic()
 
     def record(self, name: str, *, tick: int | None = None,
                span: int | None = None, span_name: str | None = None,
@@ -293,12 +389,21 @@ class FlightRecorder:
 
     def dump(self, path: str | None = None) -> str:
         """The last N events as JSON-lines; written to ``path`` when
-        given, returned either way."""
+        given, returned either way. The first line is a header record
+        (``{"header": "flight_recorder", "t0_unix": ..., ...}``)
+        carrying the wall-clock anchor — consumers add ``t0_unix`` to
+        any event's monotonic ``t`` for absolute time."""
+        events = self.events()
+        header = json.dumps({
+            "header": "flight_recorder",
+            "t0_unix": round(self.t0_unix, 6),
+            "events": len(events),
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+        })
         lines = "\n".join(
-            json.dumps(ev, default=str) for ev in self.events()
-        )
-        if lines:
-            lines += "\n"
+            [header] + [json.dumps(ev, default=str) for ev in events]
+        ) + "\n"
         if path is not None:
             with open(path, "w", encoding="utf-8") as f:
                 f.write(lines)

@@ -23,6 +23,13 @@ from mmlspark_tpu.core.faults import (  # noqa: F401
     FaultInjector,
     parse_fault_spec,
 )
+from mmlspark_tpu.core.perf import (  # noqa: F401
+    PerfAnalytics,
+    SloMonitor,
+    SloTargets,
+    export_chrome_trace,
+    parse_slo_spec,
+)
 from mmlspark_tpu.serve.cache_pool import SlotCachePool  # noqa: F401
 from mmlspark_tpu.serve.engine import ServeEngine  # noqa: F401
 from mmlspark_tpu.serve.metrics import ServeMetrics  # noqa: F401

@@ -7,9 +7,13 @@ prompt lengths drawn from a seeded rng), mirroring ``bench``'s contract:
 ONE parseable JSON line out, carrying queue-depth, TTFT, per-token
 latency, slot-utilization, and throughput metrics. With
 ``telemetry_dir`` set (the CLI's ``--telemetry-dir``), the engine's
-flight-recorder event timeline lands in ``events.jsonl`` and the full
-metrics dict in ``metrics.json`` next to it — the schema
+flight-recorder event timeline lands in ``events.jsonl``, the full
+metrics dict in ``metrics.json``, the Perfetto-loadable Chrome trace
+in ``trace.json``, and the Prometheus text exposition in
+``metrics.prom`` next to them — the schema
 ``tools/check_metrics_schema.py`` gates (docs/OBSERVABILITY.md).
+``trace_out`` (the CLI's ``--trace-out``) writes just the trace to an
+explicit path.
 """
 
 from __future__ import annotations
@@ -28,7 +32,9 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              decode_block: int | None = None,
              mesh: str | None = None,
              telemetry_dir: str | None = None,
-             faults: str | None = None) -> dict:
+             faults: str | None = None,
+             slo: str | None = None,
+             trace_out: str | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line."""
     import jax
@@ -55,6 +61,10 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         # chaos injection (docs/OBSERVABILITY.md "Fault injection");
         # None = no injector, hooks cost one attribute check
         faults=parse_fault_spec(faults) if faults else None,
+        # "ttft_p99_ms=50,error_rate=0.05"-style SLO spec -> rolling-
+        # window monitor + load shedding (docs/OBSERVABILITY.md
+        # "Declaring SLOs"); None = undeclared
+        slo=slo or None,
         retry_backoff_s=0.0,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
@@ -91,9 +101,26 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
                       "depth": depth},
     )
     if telemetry_dir:
+        from mmlspark_tpu.core.perf import export_chrome_trace
+
         os.makedirs(telemetry_dir, exist_ok=True)
         engine.recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
         with open(os.path.join(telemetry_dir, "metrics.json"), "w",
                   encoding="utf-8") as f:
             json.dump(out, f, indent=1, default=str)
+        # the full telemetry bundle: the Perfetto-loadable trace and
+        # the Prometheus text exposition land next to events/metrics
+        export_chrome_trace(
+            engine.recorder,
+            path=os.path.join(telemetry_dir, "trace.json"),
+            extra_meta={"model": graph.name},
+        )
+        with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
+                  encoding="utf-8") as f:
+            f.write(engine.metrics.registry.to_prometheus())
+    if trace_out:
+        from mmlspark_tpu.core.perf import export_chrome_trace
+
+        export_chrome_trace(engine.recorder, path=trace_out,
+                            extra_meta={"model": graph.name})
     return out

@@ -56,6 +56,12 @@ from mmlspark_tpu.core.faults import (
     is_resource_exhausted,
     is_transient,
 )
+from mmlspark_tpu.core.perf import (
+    SloMonitor,
+    SloTargets,
+    analyze_jit_cost,
+    parse_slo_spec,
+)
 from mmlspark_tpu.core.telemetry import (
     FlightRecorder,
     RetraceWatchdog,
@@ -108,7 +114,8 @@ class ServeEngine:
                  faults: FaultInjector | None = None,
                  retry_limit: int = 3,
                  retry_backoff_s: float = 0.02,
-                 degrade_recover_ticks: int = 8):
+                 degrade_recover_ticks: int = 8,
+                 slo=None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -221,6 +228,20 @@ class ServeEngine:
         #: when the builder records no vocab — validation then only
         #: rejects negatives
         self._vocab = graph.extra.get("vocab_size")
+        # SLO plane (docs/OBSERVABILITY.md "Declaring SLOs"): ``slo``
+        # accepts the CLI string spelling, SloTargets, or a prebuilt
+        # SloMonitor. When targets burn, the monitor's shed signal
+        # suppresses NEW admissions (in-flight requests finish) — load
+        # shedding composes with memory-pressure degradation: both
+        # squeeze the admit loop, neither touches compiled programs.
+        if isinstance(slo, str):
+            slo = parse_slo_spec(slo)
+        if isinstance(slo, SloTargets):
+            slo = SloMonitor(slo, recorder=self.recorder,
+                             registry=self.metrics.registry)
+        self._slo: SloMonitor | None = slo
+        if slo is not None:
+            self.metrics.attach_slo(slo)
         if self._faults is not None and self._faults.listener is None:
             # injected faults land in the same metrics + event timeline
             # as their consequences (retries, quarantines, degradation)
@@ -595,9 +616,26 @@ class ServeEngine:
         finished = self._sched.expire(tick)
         tokens_this_tick = 0
 
+        # SLO load shedding: while the monitor's budget burns, NEW
+        # admissions stop (in-flight requests keep decoding, so the
+        # overload actually drains). An IDLE engine admits regardless —
+        # with nothing in flight, shedding could never observe recovery
+        # and would deadlock the queue.
+        shedding = (
+            self._slo is not None and self._slo.should_shed
+            and self.pool.leased_count > 0
+        )
+        if shedding and self._sched.queue_depth:
+            self.metrics.record_slo_shed()
+            self.recorder.record(
+                "slo_shed", tick=tick,
+                queue_depth=self._sched.queue_depth,
+            )
+
         with annotate("serve.admit"):
             while (
-                self._sched.queue_depth
+                not shedding
+                and self._sched.queue_depth
                 and self.pool.free_count
                 # admission cap: memory-pressure degradation admits
                 # fewer concurrent requests than the pool has slots
@@ -622,6 +660,19 @@ class ServeEngine:
                     bucket = self.prefill_bucket(p)
                     padded = np.full((bucket,), self.pad_id, np.int32)
                     padded[:p] = seq
+                    # device analytics: analyze each prefill bucket's
+                    # program ONCE, from abstract shapes — lowering
+                    # only, no backend compile, no device work, so the
+                    # prefill_compile_count pin is untouched
+                    family = f"prefill[{bucket}]"
+                    if self.metrics.perf.wants_program(family):
+                        self.metrics.perf.register_program(
+                            family,
+                            analyze_jit_cost(
+                                self._prefill._fn._fn, self.variables,
+                                padded[None], p - 1,
+                            ),
+                        )
                     tp = time.perf_counter()
                     while True:
                         try:
@@ -662,11 +713,22 @@ class ServeEngine:
                     )
                     if poison is not None:
                         first = int(poison)
+                prefill_s = time.perf_counter() - tp
                 if span is not None:
                     span.event(
                         "prefill", tick=tick, bucket=bucket,
-                        ms=round((time.perf_counter() - tp) * 1e3, 3),
+                        ms=round(prefill_s * 1e3, 3),
                     )
+                # the dispatch interval ends at prefill's EXISTING
+                # host sync (int(first_d[0]) above) — analytics adds
+                # none of its own
+                self.metrics.perf.record_dispatch(
+                    family, prefill_s, tokens=1
+                )
+                self.recorder.record(
+                    "dispatch", tick=tick, family=family,
+                    ms=round(prefill_s * 1e3, 3), tokens=1,
+                )
                 if not self._token_ok(first):
                     # corrupted first token: quarantine before it can
                     # enter results or seed the decode frontier
@@ -689,9 +751,14 @@ class ServeEngine:
             tokens_this_tick += self._decode_phase(tick, finished)
 
         self._sched.tick_count += 1
+        tick_s = time.perf_counter() - t0
         self.metrics.sample_tick(
             self._sched.queue_depth, leased_this_tick,
-            time.perf_counter() - t0, tokens_emitted=tokens_this_tick,
+            tick_s, tokens_emitted=tokens_this_tick,
+        )
+        self.recorder.record(
+            "tick", tick=tick, ms=round(tick_s * 1e3, 3),
+            tokens=tokens_this_tick,
         )
         for res in finished:
             self.metrics.record_finish(res)
@@ -699,6 +766,10 @@ class ServeEngine:
             if span is not None:
                 span.end(res.status, tick=res.finish_tick,
                          generated=res.generated)
+        # SLO evaluation once per tick, AFTER the finish feed: next
+        # tick's admission sees the freshest shed signal
+        if self._slo is not None:
+            self._slo.evaluate(tick=tick)
         return finished
 
     def _decode_phase(self, tick: int, finished: list) -> int:
@@ -735,6 +806,22 @@ class ServeEngine:
             else:
                 tok_d, rem_d, eos_d = (
                     jnp.asarray(tok), jnp.asarray(rem), jnp.asarray(eos)
+                )
+            # device analytics: analyze each ladder size's program ONCE
+            # from abstract shapes, BEFORE the dispatch donates the pool
+            # buffers (ShapeDtypeStruct conversion reads only
+            # shape/dtype and keeps no buffer references). Lowering
+            # fires no backend compile, so the decode_compile_count pin
+            # and the watchdog budget are untouched.
+            family = f"decode[T={t_block}]"
+            if self.metrics.perf.wants_program(family):
+                self.metrics.perf.register_program(
+                    family,
+                    analyze_jit_cost(
+                        self._decode._fn._fn, self.variables,
+                        self.pool.buffers, self.pool.positions,
+                        self.pool.live, tok_d, rem_d, eos_d, t_block,
+                    ),
                 )
             try:
                 with annotate("serve.decode"):
@@ -844,6 +931,15 @@ class ServeEngine:
             self.metrics.record_decode(
                 n_active, decode_s, tokens_emitted=n_tokens,
                 block=t_block, live_kv=live_kv, cache_len=self.cache_len,
+            )
+            # the dispatch interval spans issue -> the block's ONE
+            # existing device_get; analytics adds no sync of its own
+            self.metrics.perf.record_dispatch(
+                family, decode_s, tokens=n_tokens
+            )
+            self.recorder.record(
+                "dispatch", tick=tick, family=family,
+                ms=round(decode_s * 1e3, 3), tokens=n_tokens,
             )
             if __debug__:
                 # the device live mask and the host's retirement

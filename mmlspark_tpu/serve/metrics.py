@@ -26,6 +26,7 @@ from __future__ import annotations
 import time
 
 from mmlspark_tpu.core.metrics_contracts import MetricData
+from mmlspark_tpu.core.perf import PerfAnalytics, SloMonitor
 from mmlspark_tpu.core.telemetry import MetricRegistry
 
 
@@ -105,6 +106,31 @@ class ServeMetrics:
         self.tick_tokens: list[int] = []
         self._t0: float | None = None
         self._t_last: float | None = None
+        #: device-level analytics (docs/OBSERVABILITY.md "Device-level
+        #: performance analytics"): the engine registers each program
+        #: family's analytic cost once and attributes every dispatch
+        #: interval here — to_dict() grows mfu / hbm_bw_util_pct / the
+        #: device-vs-host time split from it, with zero new host syncs
+        self.perf = PerfAnalytics(
+            registry=r, n_devices=max(1, mesh_devices)
+        )
+        #: rolling-window SLO monitor (attach_slo); None -> undeclared
+        self.slo: SloMonitor | None = None
+        self._slo_shed_ticks = r.counter("serve.slo_shed_ticks")
+
+    def attach_slo(self, monitor: SloMonitor) -> None:
+        """Feed the monitor from this plane's hooks: TTFT per first
+        token, per-token latency per decode dispatch, ok/error per
+        terminal status."""
+        self.slo = monitor
+
+    def record_slo_shed(self) -> None:
+        """One tick during which SLO shedding suppressed admissions."""
+        self._slo_shed_ticks.inc()
+
+    @property
+    def slo_shed_ticks_total(self) -> int:
+        return self._slo_shed_ticks.value
 
     # -- registry-backed counts (the attribute API tests assert on) --------
 
@@ -181,6 +207,8 @@ class ServeMetrics:
         ttft = time.perf_counter() - req.submit_wall
         self.ttft_s.append(ttft)
         self._ttft_ms.record(ttft * 1e3)
+        if self.slo is not None:
+            self.slo.observe_ttft(ttft * 1e3)
         if bucket is not None:
             key = str(bucket)
             self.prefill_buckets[key] = self.prefill_buckets.get(key, 0) + 1
@@ -202,6 +230,8 @@ class ServeMetrics:
         self.decode_tokens += tokens
         if tokens:
             self._per_token_ms.record(seconds / tokens * 1e3)
+            if self.slo is not None:
+                self.slo.observe_per_token(seconds / tokens * 1e3)
         key = str(block)
         self.decode_blocks[key] = self.decode_blocks.get(key, 0) + 1
         if live_kv is not None and cache_len is not None:
@@ -218,6 +248,8 @@ class ServeMetrics:
         else:
             self._completed.inc()
         self._tokens_generated.inc(result.generated)
+        if self.slo is not None:
+            self.slo.observe_finish(result.status == "completed")
         self._touch()
 
     def record_fault(self, kind: str) -> None:
@@ -253,6 +285,7 @@ class ServeMetrics:
         self.tick_seconds.append(seconds)
         self.tick_tokens.append(tokens_emitted)
         self._tick_ms.record(seconds * 1e3)
+        self.perf.record_tick(seconds)
         self._touch()
 
     # -- views -------------------------------------------------------------
@@ -346,6 +379,38 @@ class ServeMetrics:
             "preemptions_total": self.preemptions_total,
             "degraded_mode": self.degraded_mode,
             "faults_by_kind": dict(self.faults_by_kind),
+            # device-level analytics (docs/OBSERVABILITY.md
+            # "Device-level performance analytics"; schema-gated):
+            # headline utilization, the device-vs-host time split, the
+            # per-family breakdown, and the peak figures MFU is
+            # measured against (so a number is never context-free)
+            **self._perf_dict(),
+            # SLO plane (docs/OBSERVABILITY.md "Declaring SLOs"):
+            # always-present scalars for dashboards plus the full
+            # window state under "slo"
+            "slo_burning": (
+                int(self.slo.should_shed) if self.slo is not None else 0
+            ),
+            "slo_violations_total": (
+                self.slo.violations_total if self.slo is not None else 0
+            ),
+            "slo_shed_ticks_total": self.slo_shed_ticks_total,
+            "slo": (
+                self.slo.state() if self.slo is not None
+                else {"declared": False}
+            ),
+        }
+
+    def _perf_dict(self) -> dict:
+        s = self.perf.summary()
+        return {
+            "mfu": s["mfu"],
+            "hbm_bw_util_pct": s["hbm_bw_util_pct"],
+            "device_time_s": s["device_time_s"],
+            "host_time_s": s["host_time_s"],
+            "device_time_pct": s["device_time_pct"],
+            "perf_families": s["families"],
+            "perf_peak": s["peak"],
         }
 
     def snapshot(self) -> list[MetricData]:

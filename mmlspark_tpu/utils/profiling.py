@@ -21,6 +21,17 @@ import contextlib
 import os
 
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.perf import (  # noqa: F401 — re-exports
+    DevicePeak,
+    PerfAnalytics,
+    ProgramCost,
+    SloMonitor,
+    SloTargets,
+    analyze_jit_cost,
+    device_peak,
+    export_chrome_trace,
+    parse_slo_spec,
+)
 from mmlspark_tpu.core.telemetry import (  # noqa: F401 — re-exports
     Counter,
     FlightRecorder,

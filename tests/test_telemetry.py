@@ -100,7 +100,12 @@ def test_flight_recorder_ring_keeps_last_n():
     assert [e["tick"] for e in evs] == list(range(12, 20))
     assert rec.dropped == 12
     lines = rec.dump().strip().splitlines()
-    assert len(lines) == 8 and json.loads(lines[0])["tick"] == 12
+    # line 0 is the dump header carrying the wall-clock anchor
+    header = json.loads(lines[0])
+    assert header["header"] == "flight_recorder"
+    assert header["events"] == 8 and header["dropped"] == 12
+    assert abs((rec.t0_unix + time.monotonic()) - time.time()) < 1.0
+    assert len(lines) == 9 and json.loads(lines[1])["tick"] == 12
 
 
 def test_flight_recorder_dumps_on_friendly_error(tmp_path):
@@ -112,7 +117,8 @@ def test_flight_recorder_dumps_on_friendly_error(tmp_path):
             rec.record("during", tick=2)
             raise FriendlyError("boom")
     lines = [json.loads(ln) for ln in path.read_text().splitlines()]
-    assert [e["name"] for e in lines] == ["before", "during"]
+    assert lines[0]["header"] == "flight_recorder"
+    assert [e["name"] for e in lines[1:]] == ["before", "during"]
     # non-matching exceptions pass through without a dump
     with pytest.raises(ValueError):
         with rec.dump_on_friendly_error(str(tmp_path / "no.jsonl")):

@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a fresh bench JSON against the repo's
+recorded ``BENCH_*.json`` history and fail CI on a throughput
+regression, so "PR N made serving slower" is a red build, not a human
+rereading the numbers by hand (docs/OBSERVABILITY.md "The bench
+regression gate").
+
+What is compared
+----------------
+Every NUMERIC leaf whose dotted key path contains ``per_sec``
+(``tokens_per_sec``, ``serve_faults.chaos.tokens_per_sec``,
+``resnet50_images_per_sec_per_chip``, ...) — the repo's throughput
+figures, all higher-is-better. Latency figures are deliberately out of
+scope: their distributions on shared CI hosts are too heavy-tailed for
+a tolerance band to mean anything.
+
+History entries come in two shapes, both handled:
+
+- direct bench dicts (``BENCH_FULL.json``, ``BENCH_LOCAL_r4.json`` —
+  what ``tools/record_local_bench.sh`` appends);
+- driver wrappers ``{"n", "cmd", "rc", "tail", "parsed"}``
+  (``BENCH_r0*.json``): ``parsed`` is used when it is a dict, and any
+  full JSON line inside ``tail`` is recovered. Entries that yield no
+  throughput leaf (the TPU-unavailable runs) are skipped with a note —
+  they are history, not evidence.
+
+The baseline per key is the MEDIAN across history entries carrying it
+(robust to one lucky/unlucky run). The fresh value fails the gate when
+``fresh < median * (1 - tolerance)``; the default tolerance 0.15 makes
+the acceptance bar concrete: a >=20% slowdown always fails, run-to-run
+noise (the recorded serve noise floor is ~1-2%) never does. An empty
+key intersection exits 0 with a warning — a gate that cannot compare
+must not block.
+
+Usage::
+
+    python tools/bench_regression.py FRESH.json [--history 'BENCH*.json']
+                                     [--tolerance 0.15]
+    python tools/bench_regression.py --selftest
+
+``--selftest`` (the ``tools/ci.sh`` step) needs no fresh bench run: it
+replays the newest usable history entry against the full history
+(must pass) and a copy with every throughput leaf scaled by 0.75 — a
+25% slowdown — against the same history (must fail). Exit 0 means the
+gate provably catches regressions on the REAL recorded history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = "BENCH*.json"
+DEFAULT_TOLERANCE = 0.15
+_WRAPPER_KEYS = {"n", "cmd", "rc", "tail"}
+
+
+def throughput_leaves(doc, path: tuple = ()) -> dict[str, float]:
+    """Dotted-path -> value for every numeric leaf whose path mentions
+    ``per_sec``. Bools and non-positive values are skipped (a 0
+    tokens/sec is a failed run, not a comparable figure)."""
+    out: dict[str, float] = {}
+    if isinstance(doc, dict):
+        for key, value in doc.items():
+            out.update(throughput_leaves(value, path + (str(key),)))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        dotted = ".".join(path)
+        if "per_sec" in dotted and doc > 0:
+            out[dotted] = float(doc)
+    return out
+
+
+def unwrap(doc) -> list[dict]:
+    """A history file's comparable payload(s): the dict itself, or —
+    for driver wrappers — its ``parsed`` dict plus any full JSON line
+    recoverable from ``tail``."""
+    if not isinstance(doc, dict):
+        return []
+    if not _WRAPPER_KEYS.issubset(doc):
+        return [doc]
+    payloads = []
+    if isinstance(doc.get("parsed"), dict):
+        payloads.append(doc["parsed"])
+    for line in str(doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict):
+                payloads.append(parsed)
+    return payloads
+
+
+def load_history(pattern: str) -> tuple[dict[str, list[float]], list[str]]:
+    """key -> every historical value, plus the usable file names."""
+    values: dict[str, list[float]] = {}
+    used: list[str] = []
+    for path in sorted(glob.glob(os.path.join(REPO, pattern))):
+        try:
+            doc = json.load(open(path, encoding="utf-8"))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_regression: skipping {os.path.basename(path)}: "
+                  f"{e}", file=sys.stderr)
+            continue
+        leaves: dict[str, float] = {}
+        for payload in unwrap(doc):
+            leaves.update(throughput_leaves(payload))
+        if not leaves:
+            print(
+                f"bench_regression: {os.path.basename(path)} carries no "
+                "throughput figures (unavailable-backend run), skipping",
+                file=sys.stderr,
+            )
+            continue
+        used.append(os.path.basename(path))
+        for key, value in leaves.items():
+            values.setdefault(key, []).append(value)
+    return values, used
+
+
+def compare(fresh: dict[str, float], history: dict[str, list[float]],
+            tolerance: float) -> tuple[list[str], list[str]]:
+    """-> (per-key report lines, regression lines). Keys only one side
+    has are reported but never fail the gate — a NEW metric must not
+    break CI the day it lands."""
+    report: list[str] = []
+    regressions: list[str] = []
+    for key in sorted(set(fresh) & set(history)):
+        baseline = statistics.median(history[key])
+        floor = baseline * (1.0 - tolerance)
+        value = fresh[key]
+        delta_pct = 100.0 * (value - baseline) / baseline
+        line = (
+            f"{key}: fresh {value:.1f} vs baseline {baseline:.1f} "
+            f"(median of {len(history[key])}) -> {delta_pct:+.1f}%"
+        )
+        if value < floor:
+            regressions.append(f"{line}  [below -{tolerance:.0%} band]")
+        else:
+            report.append(line)
+    for key in sorted(set(fresh) - set(history)):
+        report.append(f"{key}: fresh {fresh[key]:.1f} (no history — "
+                      "informational)")
+    return report, regressions
+
+
+def run_gate(fresh_path: str, pattern: str, tolerance: float) -> int:
+    try:
+        doc = json.load(open(fresh_path, encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_regression: FAIL — cannot read fresh bench "
+              f"{fresh_path}: {e}", file=sys.stderr)
+        return 1
+    fresh: dict[str, float] = {}
+    for payload in unwrap(doc):
+        fresh.update(throughput_leaves(payload))
+    history, used = load_history(pattern)
+    if not fresh or not set(fresh) & set(history):
+        print(
+            "bench_regression: WARN — no comparable throughput keys "
+            f"between {os.path.basename(fresh_path)} and history "
+            f"({', '.join(used) or 'none usable'}); nothing to gate"
+        )
+        return 0
+    report, regressions = compare(fresh, history, tolerance)
+    for line in report:
+        print(f"bench_regression: ok   {line}")
+    for line in regressions:
+        print(f"bench_regression: FAIL {line}", file=sys.stderr)
+    if regressions:
+        print(
+            f"bench_regression: FAIL — {len(regressions)} throughput "
+            f"regression(s) beyond the {tolerance:.0%} tolerance band "
+            f"(history: {', '.join(used)})", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"bench_regression: OK — {len(report)} throughput figure(s) "
+        f"within the {tolerance:.0%} band of {', '.join(used)}"
+    )
+    return 0
+
+
+def _scale_leaves(doc, factor: float, path: tuple = ()):
+    """Copy with every throughput leaf multiplied by ``factor`` — the
+    selftest's injected slowdown."""
+    if isinstance(doc, dict):
+        return {
+            k: _scale_leaves(v, factor, path + (str(k),))
+            for k, v in doc.items()
+        }
+    if (
+        isinstance(doc, (int, float)) and not isinstance(doc, bool)
+        and "per_sec" in ".".join(path)
+    ):
+        return doc * factor
+    return doc
+
+
+def run_selftest(pattern: str, tolerance: float) -> int:
+    """Prove the gate on the real history: the newest usable entry must
+    pass against the full history; the same entry with a 25% injected
+    slowdown must fail."""
+    import tempfile
+
+    history, used = load_history(pattern)
+    if not history:
+        print("bench_regression: WARN — selftest found no usable "
+              "history; nothing to prove")
+        return 0
+    # newest usable file = last in sorted order that contributed
+    newest = None
+    for path in sorted(glob.glob(os.path.join(REPO, pattern))):
+        if os.path.basename(path) in used:
+            newest = path
+    doc = json.load(open(newest, encoding="utf-8"))
+    with tempfile.TemporaryDirectory() as tdir:
+        clean = os.path.join(tdir, "fresh.json")
+        slow = os.path.join(tdir, "slow.json")
+        json.dump(doc, open(clean, "w", encoding="utf-8"))
+        json.dump(_scale_leaves(doc, 0.75), open(slow, "w",
+                                                 encoding="utf-8"))
+        rc_clean = run_gate(clean, pattern, tolerance)
+        rc_slow = run_gate(slow, pattern, tolerance)
+    if rc_clean != 0:
+        print(
+            "bench_regression: SELFTEST FAIL — the newest usable "
+            f"history entry ({os.path.basename(newest)}) does not pass "
+            "against its own history", file=sys.stderr,
+        )
+        return 1
+    if rc_slow == 0:
+        print(
+            "bench_regression: SELFTEST FAIL — a 25% injected slowdown "
+            "was NOT caught", file=sys.stderr,
+        )
+        return 1
+    print(
+        "bench_regression: SELFTEST OK — clean history passes, a 25% "
+        "injected slowdown fails "
+        f"(tolerance {tolerance:.0%}, history: {', '.join(used)})"
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on throughput regression vs BENCH history"
+    )
+    ap.add_argument("fresh", nargs="?", metavar="FRESH.json",
+                    help="fresh bench JSON (one `python bench.py` line)")
+    ap.add_argument("--history", default=DEFAULT_HISTORY, metavar="GLOB",
+                    help=f"history glob under the repo root "
+                    f"(default: {DEFAULT_HISTORY})")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed fractional slowdown before failing "
+                    f"(default: {DEFAULT_TOLERANCE} -> a >=20%% "
+                    "regression always fails)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="prove the gate catches an injected 25%% "
+                    "slowdown on the real history (no fresh run needed)")
+    args = ap.parse_args()
+    if args.selftest:
+        return run_selftest(args.history, args.tolerance)
+    if not args.fresh:
+        ap.error("FRESH.json required (or --selftest)")
+    return run_gate(args.fresh, args.history, args.tolerance)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

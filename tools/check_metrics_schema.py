@@ -8,9 +8,17 @@ Three surfaces, all produced by ONE subprocess run at smoke scale:
   carrying every historical ``ServeMetrics.to_dict()`` key plus the
   telemetry plane's percentile keys with the right types;
 - ``metrics.json``: the same dict persisted under ``--telemetry-dir``;
-- ``events.jsonl``: the flight recorder's timeline — every submitted
-  request must appear as one COMPLETE span (start -> queued -> admitted
-  -> prefill -> terminal status).
+- ``events.jsonl``: the flight recorder's timeline — a header line
+  carrying the ``t0_unix`` wall-clock anchor, every submitted request
+  as one COMPLETE span (start -> queued -> admitted -> prefill ->
+  terminal status), and the ``tick``/``dispatch`` event names the
+  trace exporter keys on;
+- ``trace.json`` (+ the explicit ``--trace-out`` path): valid Chrome
+  trace-event JSON — per-request slices, tick + dispatch tracks,
+  ts-ordered (Perfetto-loadable; docs/OBSERVABILITY.md "Trace
+  export");
+- ``metrics.prom``: the Prometheus text exposition with real
+  histogram ``_bucket`` series.
 
 Exits non-zero with a pointed message on the first violation, so
 ``tools/ci.sh`` catches schema drift before a dashboard does
@@ -89,12 +97,35 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "preemptions_total": (int,),
     "degraded_mode": (int,),
     "faults_by_kind": (dict,),
+    # device-level performance analytics (docs/OBSERVABILITY.md
+    # "Device-level performance analytics"): the demo run's backend has
+    # a working XLA cost model, so the utilization figures must be real
+    # numbers — None would mean the cost-analysis path silently broke
+    "mfu": NUM,
+    "hbm_bw_util_pct": NUM,
+    "device_time_s": NUM,
+    "host_time_s": NUM,
+    "device_time_pct": NUM,
+    "perf_families": (dict,),
+    "perf_peak": (dict,),
+    # SLO plane (docs/OBSERVABILITY.md "Declaring SLOs"): the scalars
+    # dashboards alert on are always present; the full window state
+    # rides under "slo"
+    "slo_burning": (int,),
+    "slo_violations_total": (int,),
+    "slo_shed_ticks_total": (int,),
+    "slo": (dict,),
     # demo envelope
     "n_requests": (int,),
     "decode_compiles": (int,),
     "prefill_compiles": (int,),
     "prefill_bucket_count": (int,),
 }
+
+#: engine-emitted event names the trace exporter keys on — renaming
+#: any of these breaks trace.json's tick/dispatch tracks, so the gate
+#: pins their presence in a demo run's events.jsonl
+REQUIRED_EVENT_NAMES = {"dispatch", "tick"}
 
 
 def fail(msg: str) -> "None":
@@ -119,16 +150,36 @@ def check_events(path: str, n_requests: int) -> int:
         lines = open(path, encoding="utf-8").read().splitlines()
     except OSError as e:
         fail(f"events.jsonl unreadable: {e}")
+    if not lines:
+        fail("events.jsonl is empty")
+    # line 1 is the dump header carrying the wall-clock anchor that
+    # correlates traces across processes (docs/OBSERVABILITY.md)
+    try:
+        header = json.loads(lines[0])
+    except json.JSONDecodeError as e:
+        fail(f"events.jsonl header line is not JSON: {e}")
+    if header.get("header") != "flight_recorder":
+        fail(f"events.jsonl must open with the dump header, got {header}")
+    if not isinstance(header.get("t0_unix"), (int, float)):
+        fail(f"dump header lacks a numeric t0_unix anchor: {header}")
     spans: dict[int, list[str]] = {}
-    for i, line in enumerate(lines, 1):
+    names_seen: set[str] = set()
+    for i, line in enumerate(lines[1:], 2):
         try:
             ev = json.loads(line)
         except json.JSONDecodeError as e:
             fail(f"events.jsonl line {i} is not JSON: {e}")
         if "t" not in ev or "name" not in ev:
             fail(f"events.jsonl line {i} lacks 't'/'name': {ev}")
+        names_seen.add(ev["name"])
         if ev.get("span_name") == "request":
             spans.setdefault(ev["span"], []).append(ev["name"])
+    missing_names = REQUIRED_EVENT_NAMES - names_seen
+    if missing_names:
+        fail(
+            f"events.jsonl lacks engine event names {missing_names} "
+            "(the trace exporter's tick/dispatch tracks key on them)"
+        )
     if len(spans) != n_requests:
         fail(
             f"events.jsonl holds {len(spans)} request spans, expected "
@@ -142,7 +193,67 @@ def check_events(path: str, n_requests: int) -> int:
             fail(f"span {sid} lacks lifecycle events {missing}: {names}")
         if names[-1] not in ("completed", "expired", "failed", "stalled"):
             fail(f"span {sid} never reached a terminal status: {names}")
-    return len(lines)
+    return len(lines) - 1
+
+
+def check_trace(path: str, n_requests: int) -> int:
+    """One schema pass over an emitted Chrome trace-event JSON: valid
+    structure, metadata naming, one complete request slice per
+    submitted request, and populated tick + dispatch tracks."""
+    try:
+        doc = json.load(open(path, encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"trace json unreadable at {path}: {e}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail(f"{path}: traceEvents must be a non-empty list")
+    if not isinstance(doc.get("otherData", {}).get("t0_unix"),
+                      (int, float)):
+        fail(f"{path}: otherData.t0_unix anchor missing")
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "pid", "tid", "ts"):
+            if key not in ev:
+                fail(f"{path}: event {i} lacks {key!r}: {ev}")
+        if ev["ph"] not in ("M", "X", "i"):
+            fail(f"{path}: event {i} has unknown phase {ev['ph']!r}")
+        if ev["ph"] == "X" and not isinstance(ev.get("dur"),
+                                              (int, float)):
+            fail(f"{path}: complete slice {i} lacks numeric dur: {ev}")
+    meta_names = {
+        ev["args"]["name"] for ev in events
+        if ev["ph"] == "M" and ev["name"] == "process_name"
+    }
+    if {"serve.requests", "serve.engine"} - meta_names:
+        fail(f"{path}: process metadata incomplete, got {meta_names}")
+    req_slices = [
+        ev for ev in events
+        if ev["ph"] == "X" and ev["pid"] == 1
+        and ev["name"].startswith("request ")
+    ]
+    if len(req_slices) != n_requests:
+        fail(
+            f"{path}: {len(req_slices)} request slices, expected one "
+            f"per submitted request ({n_requests})"
+        )
+    tick_slices = [
+        ev for ev in events
+        if ev["ph"] == "X" and ev["pid"] == 2
+        and ev["name"].startswith("tick ")
+    ]
+    dispatch_slices = [
+        ev for ev in events
+        if ev["ph"] == "X" and ev["pid"] == 2
+        and ("decode[" in ev["name"] or "prefill[" in ev["name"])
+    ]
+    if not tick_slices:
+        fail(f"{path}: no tick slices on the engine track")
+    if not dispatch_slices:
+        fail(f"{path}: no program-dispatch slices on the engine track")
+    ts_order = [ev["ts"] for ev in events]
+    meta_count = sum(1 for ev in events if ev["ph"] == "M")
+    if ts_order[meta_count:] != sorted(ts_order[meta_count:]):
+        fail(f"{path}: trace events are not ts-ordered")
+    return len(events)
 
 
 def main() -> None:
@@ -159,6 +270,14 @@ def main() -> None:
             "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
             "--mesh", "data=2,model=2",
             "--telemetry-dir", tdir,
+            # generous targets: the SLO plane runs (declared state,
+            # window arithmetic, per-tick evaluation) without actually
+            # shedding a smoke-scale CPU run
+            "--slo", "ttft_p99_ms=60000,per_token_p99_ms=60000,"
+            "error_rate=0.99",
+            # exercise the explicit flag too; the --telemetry-dir
+            # bundle writes its own trace.json alongside
+            "--trace-out", os.path.join(tdir, "trace_out.json"),
         ]
         res = subprocess.run(
             cmd, capture_output=True, text=True, timeout=300,
@@ -197,13 +316,28 @@ def main() -> None:
         check_metrics_dict(
             json.load(open(mpath, encoding="utf-8")), "metrics.json"
         )
+        if stdout_metrics.get("slo", {}).get("declared") is not True:
+            fail("stdout: a --slo run must report slo.declared == true")
         n_events = check_events(
             os.path.join(tdir, "events.jsonl"), N_REQUESTS
         )
+        n_trace = check_trace(
+            os.path.join(tdir, "trace.json"), N_REQUESTS
+        )
+        check_trace(os.path.join(tdir, "trace_out.json"), N_REQUESTS)
+        ppath = os.path.join(tdir, "metrics.prom")
+        if not os.path.exists(ppath):
+            fail("--telemetry-dir did not produce metrics.prom")
+        prom = open(ppath, encoding="utf-8").read()
+        for needle in ("# TYPE perf_mfu gauge", "serve_ttft_ms_bucket{",
+                       'le="+Inf"', "serve_submitted_total"):
+            if needle not in prom:
+                fail(f"metrics.prom lacks {needle!r}")
     print(
         f"check_metrics_schema: OK — {len(REQUIRED_METRIC_KEYS)} metric "
         f"keys on both surfaces, {N_REQUESTS} complete request spans "
-        f"across {n_events} events"
+        f"across {n_events} events, {n_trace} trace events, prom "
+        "exposition present"
     )
 
 

@@ -96,6 +96,29 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
+  step "bench regression gate (selftest vs the recorded BENCH history)"
+  # proves the tolerance-band logic on the REAL history: the newest
+  # usable entry must pass, a 25% injected slowdown must fail — no
+  # fresh bench run needed. Gating a fresh run:
+  #   python bench.py > /tmp/fresh.json \
+  #     && python tools/bench_regression.py /tmp/fresh.json
+  python tools/bench_regression.py --selftest
+
+  step "trace-export smoke (serve --trace-out -> Perfetto-loadable JSON)"
+  trace_tmp=$(mktemp -d)
+  JAX_PLATFORMS=cpu python -m mmlspark_tpu serve --demo --slots 2 \
+    --requests 3 --max-new-tokens 4 --trace-out "$trace_tmp/trace.json" \
+    > /dev/null
+  python - "$trace_tmp/trace.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert evs and all("ph" in e and "ts" in e for e in evs), "malformed trace"
+assert any(e["ph"] == "X" and e["name"].startswith("request ") for e in evs)
+print("trace-export smoke:", len(evs), "events, Chrome trace-event JSON ok")
+PY
+  rm -rf "$trace_tmp"
+
   step "docgen"
   python tools/docgen.py
 

@@ -13,14 +13,28 @@ python bench.py | tail -n 1 > "$out"
 python - "$out" <<'PY'
 import json, sys
 line = json.load(open(sys.argv[1]))
+serve = line.get("serve") or {}
 print("recorded:", {k: line.get(k) for k in
       ("value", "backend", "scale", "device_kind", "resnet50_mfu",
        "stage_images_per_sec_per_chip", "error_class")})
+# device-level serve analytics (docs/OBSERVABILITY.md): keep the BENCH
+# history comparable as the analytics keys land in the serve group
+print("serve analytics:", {k: serve.get(k) for k in
+      ("tokens_per_sec", "mfu", "hbm_bw_util_pct", "device_time_pct",
+       "slo_burning", "slo_violations_total")})
 if line.get("value") is None:
     raise SystemExit(
         "no TPU headline value landed - artifact saved but NOT worth "
         "committing as a perf claim; see error fields")
 PY
+
+# gate the fresh artifact against the committed history BEFORE it is
+# committed: a recorded regression should be a loud decision, not a
+# silent append (tools/bench_regression.py; override with
+# MMLTPU_BENCH_NO_GATE=1 when recording a known-slower configuration)
+if [ "${MMLTPU_BENCH_NO_GATE:-}" != "1" ]; then
+  python tools/bench_regression.py "$out"
+fi
 git add "$out"
 git commit -m "Record in-session TPU bench artifact ${out}"
 echo "committed ${out}"
