@@ -119,6 +119,7 @@ _GROUPS = {
     "serve": ("serve",),
     "serve_sharded": ("serve_sharded",),
     "serve_faults": ("serve_faults",),
+    "serve_paged": ("serve_paged",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -917,6 +918,141 @@ def bench_serve_faults(jax) -> dict:
     return {"serve_faults": out}
 
 
+def bench_serve_paged(jax) -> dict:
+    """Paged KV-cache proof (docs/SERVING.md "Paged KV cache"): the
+    dense slot pool vs the paged pool at EQUAL concurrency, plus a
+    shared-prefix workload through the prefix cache. Three claims, one
+    dict:
+
+    - throughput: ``tokens_per_sec_dense`` vs ``tokens_per_sec_paged``
+      (same engine, same traffic — the page indirection must cost
+      ~nothing; both leaves feed tools/bench_regression.py's band);
+    - memory: ``cache_pool_bytes_per_device`` for both pools, with
+      ``num_pages`` sized to the WORKLOAD's page demand instead of the
+      dense pool's ``slots * cache_len`` worst case —
+      ``kv_bytes_saved_pct`` is the paging win, and must be positive;
+    - prefix cache: every request shares a two-page prompt header, so
+      the header prefills ONCE per unique prefix — ``prefix_hit_rate``
+      (> 0), ``prefill_tokens_saved`` and the fraction of total prompt
+      tokens never recomputed, plus ``cow_copies_total`` from write
+      frontiers entering shared pages."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve import ServeEngine
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 32, 2, 2)
+    )
+    slots, n_req, max_new = (8, 16, 32) if full else (4, 8, 8)
+    cache_len = 128 if full else 64
+    page_size = 16 if full else 8
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len,
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    rng = np.random.default_rng(23)
+    p_hi = 2 * page_size
+    prompts = [
+        rng.integers(0, vocab, size=int(n)).astype(np.int32)
+        for n in rng.integers(4, p_hi + 1, size=n_req)
+    ]
+    # size the page budget to the workload, not the worst case: the
+    # longest request (the shared-prefix one: two-page header + tail)
+    # touches ceil((longest + max_new) / page_size) pages — well under
+    # the dense pool's slots * max_pages; the slack covers the trash
+    # page plus the pages prefix-cache entries keep pinned
+    longest = max(p_hi, 2 * page_size + 8) + max_new
+    pages_hot = slots * -(-longest // page_size)
+    num_pages = pages_hot + 8
+
+    def drive(paged: bool, prefix: bool = False, workload=None):
+        engine = ServeEngine(
+            graph, variables, slots=slots, cache_len=cache_len,
+            max_queue=n_req, decode_block=page_size, paged=paged,
+            **(
+                {"page_size": page_size, "num_pages": num_pages,
+                 "prefix_cache": prefix}
+                if paged else {}
+            ),
+        )
+        reqs = workload if workload is not None else prompts
+
+        def run():
+            for pr in reqs:
+                engine.submit(pr, max_new_tokens=max_new)
+            engine.run()
+
+        run()  # warm-up: compiles the ladder once per engine
+        secs = min(_timed(run) for _ in range(3))
+        return engine, len(reqs) * max_new / secs
+
+    dense_eng, dense_tps = drive(paged=False)
+    paged_eng, paged_tps = drive(paged=True)
+    dense_bytes = dense_eng.pool.device_bytes_per_device()
+    paged_bytes = paged_eng.pool.device_bytes_per_device()
+
+    # shared-prefix workload: one two-page header + per-request tails,
+    # so every admit after the first resumes from the cached header
+    header = rng.integers(0, vocab, size=2 * page_size)
+    shared = [
+        np.concatenate(
+            [header, rng.integers(0, vocab, size=int(t))]
+        ).astype(np.int32)
+        for t in rng.integers(4, 9, size=n_req)
+    ]
+    prefix_eng, prefix_tps = drive(paged=True, prefix=True, workload=shared)
+    pstats = prefix_eng.pool.paging_stats()
+    # the timing loop drives the workload 4x (warm-up + best-of-3);
+    # rates normalize per submitted request so reruns don't inflate them
+    submitted = 4 * n_req
+    prompt_tokens = 4 * sum(int(s.size) for s in shared)
+
+    out: dict = {
+        "tokens_per_sec_dense": round(dense_tps, 1),
+        "tokens_per_sec_paged": round(paged_tps, 1),
+        "tokens_per_sec_prefix": round(prefix_tps, 1),
+        "paged_overhead_pct": round((dense_tps / paged_tps - 1) * 100, 2),
+        "cache_pool_bytes_per_device_dense": dense_bytes,
+        "cache_pool_bytes_per_device_paged": paged_bytes,
+        "kv_bytes_saved_pct": round(
+            (1 - paged_bytes / dense_bytes) * 100, 1
+        ),
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "prefix_hit_rate": round(
+            pstats["prefix_cache_hits_total"] / submitted, 3
+        ),
+        "prefill_tokens_saved": pstats["prefix_tokens_saved_total"],
+        "prefill_fraction_saved": round(
+            pstats["prefix_tokens_saved_total"] / prompt_tokens, 3
+        ),
+        "cow_copies_total": pstats["cow_copies_total"],
+        "prefix_cache_entries": pstats["prefix_cache_entries"],
+        "decode_compiles_paged": paged_eng.decode_compile_count,
+        "resume_compiles": prefix_eng.resume_compile_count,
+        "model": {"vocab": vocab, "d_model": d_model, "heads": heads,
+                  "depth": depth, "requests": n_req, "max_new": max_new,
+                  "slots": slots, "cache_len": cache_len},
+        "timing": ("full ServeEngine drive per pool, warm-up then "
+                   "best-of-3, equal traffic and concurrency"),
+    }
+    if paged_bytes >= dense_bytes:
+        raise RuntimeError(
+            f"paged pool ({paged_bytes} B/device) must undercut the "
+            f"dense worst-case reservation ({dense_bytes} B/device)"
+        )
+    if not pstats["prefix_cache_hits_total"]:
+        raise RuntimeError(
+            "shared-prefix workload produced no prefix-cache hits"
+        )
+    return {"serve_paged": out}
+
+
 def bench_serve_sharded() -> dict:
     """Mesh-sharded serving scaling sweep (docs/SERVING.md "Sharded
     serving"): the SAME synthetic-traffic demo as the ``serve`` group,
@@ -1414,6 +1550,7 @@ def run(attempt: int) -> dict:
         "decode": lambda: bench_decode(jax, jnp),
         "serve": lambda: bench_serve(jax),
         "serve_faults": lambda: bench_serve_faults(jax),
+        "serve_paged": lambda: bench_serve_paged(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),

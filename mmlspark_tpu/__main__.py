@@ -82,6 +82,9 @@ def cmd_serve(args) -> int:
         faults=args.faults or None,
         slo=args.slo or None,
         trace_out=args.trace_out or None,
+        paged=args.paged,
+        page_size=args.page_size,
+        prefix_cache=args.prefix_cache,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -245,6 +248,28 @@ def main(argv: list[str] | None = None) -> int:
         "Faulted requests quarantine as status 'failed'; the run's "
         "retry/quarantine/degradation counters land in the JSON line "
         "(docs/OBSERVABILITY.md 'Fault injection')",
+    )
+    sp.add_argument(
+        "--paged", action="store_true",
+        help="serve from the PAGED KV-cache pool: fixed-size pages + "
+        "per-slot page tables instead of dense worst-case slot slabs — "
+        "same compiled programs and bit-identical greedy tokens, HBM "
+        "scales with pages actually mapped (docs/SERVING.md 'Paged KV "
+        "cache')",
+    )
+    sp.add_argument(
+        "--page-size", type=int, default=None, metavar="P",
+        help="tokens per KV page (requires --paged; >= 8, dividing "
+        "cache_len; default: smallest such divisor). Doubles as the "
+        "paged decode kernel's KV block",
+    )
+    sp.add_argument(
+        "--prefix-cache", action="store_true",
+        help="reuse shared prompt prefixes across requests (requires "
+        "--paged): completed prefills register their pages under the "
+        "prompt hash, later prompts map them refcounted and prefill "
+        "only the remainder (copy-on-extend on divergence); the JSON "
+        "line grows prefix_cache_hits_total / cow_copies_total",
     )
     sp.set_defaults(fn=cmd_serve)
 

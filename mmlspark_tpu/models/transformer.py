@@ -148,57 +148,109 @@ class SelfAttention(nn.Module):
                     "per-row cache positions (the serve engine's fused "
                     "decode step) are single-token and linear-cache only"
                 )
-            ck, cv = cache
-            if per_row:
-                # multi-tenant decode (mmlspark_tpu.serve): every batch
-                # row is a different request writing its own absolute
-                # position in its own slot buffer
+            if len(cache) == 3:
+                # PAGED slot cache (mmlspark_tpu/serve/paging.py): K/V
+                # are physical page stores (num_pages, hk, page_size, d)
+                # shared by all rows, plus a (B, max_pages) page table
+                # mapping each row's logical positions through its pages.
+                # This is strictly the serve engine's fused decode-block
+                # format — prefill runs on a linear batch-1 cache and
+                # the pool scatters it into pages host-side.
+                if not (per_row and decode and t == 1):
+                    raise ParamError(
+                        "paged caches serve per-row single-token decode "
+                        "only (the serve engine's fused decode step); "
+                        "prefill uses the linear cache path"
+                    )
+                ck, cv, ptab = cache
+                ps = ck.shape[2]
+                virt = ptab.shape[1] * ps
+                if self.window is not None and self.window < virt:
+                    raise ParamError(
+                        f"paged decode has no windowed read: window "
+                        f"({self.window}) must cover the virtual cache "
+                        f"({virt})"
+                    )
+                # scatter this step's K/V through the table: row b's
+                # position pos[b] lands in physical page
+                # ptab[b, pos // ps] at offset pos % ps. Dead rows hold
+                # a frozen pos whose page the pool keeps pointed at a
+                # trash page, so their writes never touch live data.
                 rows = jnp.arange(b)
-                ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
-                cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
-            else:
-                # rolled (O(window) circular, sliding-window models on
-                # long generations): this step's K/V land at slot
-                # pos % W — every written slot is inside the window by
-                # construction (ops/attention.py
-                # rolled_window_attention). Linear: the write index IS
-                # the absolute position.
-                idx = pos % ck.shape[1] if rolled else pos
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (0, idx, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (0, idx, 0, 0)
-                )
-            new_cache = (ck, cv)
-            if rolled:
-                from mmlspark_tpu.ops.attention import (
-                    rolled_window_attention,
-                )
-
-                o = rolled_window_attention(q, ck, cv, pos)
-            elif decode and t == 1 and (
-                self.window is None or self.window >= ck.shape[1]
-            ):
-                # single-token DECODE step over a linear cache: the
-                # length-aware split-KV kernel reads only each row's
-                # LIVE positions [0, pos+1) — per-row work O(pos), not
-                # O(cache_len) — instead of a dense read of the whole
-                # buffer. Window models reach here only when the window
-                # covers the buffer (masking would be a no-op); a
-                # tighter window uses the rolled path or dense fallback.
+                pages = ptab[rows, pos // ps]
+                offs = pos % ps
+                hidx = jnp.arange(ck.shape[1])
+                ck = ck.at[pages[:, None], hidx[None, :], offs[:, None]
+                           ].set(k[:, 0].astype(ck.dtype))
+                cv = cv.at[pages[:, None], hidx[None, :], offs[:, None]
+                           ].set(v[:, 0].astype(cv.dtype))
+                new_cache = (ck, cv, ptab)
                 from mmlspark_tpu.ops.attention import decode_live_lengths
-                from mmlspark_tpu.ops.flash_attention import flash_decode
+                from mmlspark_tpu.ops.flash_attention import (
+                    paged_flash_decode,
+                )
 
-                # ``live`` (the serve engine's fused decode-block carry)
-                # zeroes dead rows' lengths, so the kernel's early-out
-                # skips their cache traffic mid-block
-                o = flash_decode(
-                    q, ck, cv, decode_live_lengths(pos, b, live=live)
+                o = paged_flash_decode(
+                    q, ck, cv, decode_live_lengths(pos, b, live=live),
+                    ptab,
                 )
             else:
-                o = dense_attention(q, ck, cv, causal=True,
-                                    window=self.window, q_offset=pos)
+                ck, cv = cache
+                if per_row:
+                    # multi-tenant decode (mmlspark_tpu.serve): every
+                    # batch row is a different request writing its own
+                    # absolute position in its own slot buffer
+                    rows = jnp.arange(b)
+                    ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
+                    cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+                else:
+                    # rolled (O(window) circular, sliding-window models
+                    # on long generations): this step's K/V land at slot
+                    # pos % W — every written slot is inside the window
+                    # by construction (ops/attention.py
+                    # rolled_window_attention). Linear: the write index
+                    # IS the absolute position.
+                    idx = pos % ck.shape[1] if rolled else pos
+                    ck = jax.lax.dynamic_update_slice(
+                        ck, k.astype(ck.dtype), (0, idx, 0, 0)
+                    )
+                    cv = jax.lax.dynamic_update_slice(
+                        cv, v.astype(cv.dtype), (0, idx, 0, 0)
+                    )
+                new_cache = (ck, cv)
+                if rolled:
+                    from mmlspark_tpu.ops.attention import (
+                        rolled_window_attention,
+                    )
+
+                    o = rolled_window_attention(q, ck, cv, pos)
+                elif decode and t == 1 and (
+                    self.window is None or self.window >= ck.shape[1]
+                ):
+                    # single-token DECODE step over a linear cache: the
+                    # length-aware split-KV kernel reads only each row's
+                    # LIVE positions [0, pos+1) — per-row work O(pos),
+                    # not O(cache_len) — instead of a dense read of the
+                    # whole buffer. Window models reach here only when
+                    # the window covers the buffer (masking would be a
+                    # no-op); a tighter window uses the rolled path or
+                    # dense fallback.
+                    from mmlspark_tpu.ops.attention import (
+                        decode_live_lengths,
+                    )
+                    from mmlspark_tpu.ops.flash_attention import (
+                        flash_decode,
+                    )
+
+                    # ``live`` (the serve engine's fused decode-block
+                    # carry) zeroes dead rows' lengths, so the kernel's
+                    # early-out skips their cache traffic mid-block
+                    o = flash_decode(
+                        q, ck, cv, decode_live_lengths(pos, b, live=live)
+                    )
+                else:
+                    o = dense_attention(q, ck, cv, causal=True,
+                                        window=self.window, q_offset=pos)
         elif impl == FLASH:
             from mmlspark_tpu.ops.flash_attention import flash_attention
 

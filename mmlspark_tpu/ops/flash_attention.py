@@ -746,3 +746,177 @@ def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
         interpret=bool(interpret),
     )(lengths, qb, kb, vb)
     return _from_bh(out, b, h, 1)
+
+
+# ---------------------------------------------------------------------------
+# paged flash decode: the same split-KV walk through a page-table
+# indirection. The paged cache pool (mmlspark_tpu/serve/paging.py) stores
+# K/V as (num_pages, hk, page_size, d) physical pages and maps each
+# slot's logical positions through a (slots, max_pages) int32 page table.
+# flash_decode already walks the KV stream block-by-block with the block
+# coordinate computed in a scalar-prefetched index map — so paging costs
+# ONE extra prefetch argument and one table load in that map: with
+# page_size == block, logical block j of row s simply lives at physical
+# page pt[s, j], the grid shape is unchanged, and the live-length clamp
+# early-out carries over verbatim (dead logical blocks re-reference the
+# resident tile through the same clamped coordinate).
+
+
+def _paged_decode_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *,
+                         scale: float, blk: int, heads: int):
+    # body of _decode_kernel against (page_size, d) page faces; kpos is
+    # the LOGICAL position (page index kb is logical — only the fetch
+    # coordinate went through the table)
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    length = len_ref[bh // heads]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kb * blk < length)
+    def _update():
+        q = jnp.broadcast_to(q_ref[0], (SUBLANES, q_ref.shape[-1]))
+        s = jax.lax.dot_general(
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        kpos = kb * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos >= length, NEG_INF, s)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_scr[:1, :1]
+        o_ref[0] = (
+            acc_scr[:1] / jnp.where(l == 0.0, 1.0, l)
+        ).astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
+                       scale=None, interpret: bool | None = None):
+    """:func:`flash_decode` over PAGED caches.
+
+    ``q`` is (B, 1, H, D); ``k_pages``/``v_pages`` are the physical page
+    stores ``(num_pages, Hkv, page_size, D)`` shared by all rows;
+    ``page_table`` is (B, max_pages) int32 mapping row b's logical page
+    j to physical page ``page_table[b, j]`` (every entry must be a valid
+    page id — the pool points unmapped entries at a trash page);
+    ``lengths`` is the (B,) live-length vector of :func:`flash_decode`,
+    in LOGICAL positions. The virtual cache length is ``max_pages *
+    page_size``.
+
+    ``page_size`` doubles as the KV block, so the grid is (B·H,
+    max_pages) — exactly flash_decode's shape for ``block ==
+    page_size`` — and both scalar-prefetch arguments feed the kv index
+    map: the live-length clamp picks the logical block, the table turns
+    it physical. Per-row work and HBM traffic remain O(lengths[b]).
+    """
+    if not (q.dtype == k_pages.dtype == v_pages.dtype):
+        raise ValueError(
+            "paged_flash_decode requires q, k, v to share one dtype, got "
+            f"{q.dtype}/{k_pages.dtype}/{v_pages.dtype}"
+        )
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(
+            "paged_flash_decode takes a SINGLE query token per row: q "
+            f"must be (B, 1, H, D), got {q.shape}"
+        )
+    if k_pages.ndim != 4 or k_pages.shape != v_pages.shape:
+        raise ValueError(
+            "k_pages/v_pages must share one (num_pages, Hkv, page_size, "
+            f"D) shape, got {k_pages.shape} vs {v_pages.shape}"
+        )
+    if k_pages.shape[1] != v_pages.shape[1] or q.shape[2] % k_pages.shape[1]:
+        raise ValueError(
+            "paged_flash_decode needs k/v heads equal and dividing q "
+            f"heads, got q={q.shape[2]} kv={k_pages.shape[1]}"
+        )
+    b, _, h, d = q.shape
+    ps = k_pages.shape[2]
+    if ps % SUBLANES:
+        raise ValueError(
+            f"page_size must be a multiple of {SUBLANES} (the TPU "
+            f"sublane tile), got {ps}"
+        )
+    page_table = jnp.asarray(page_table)
+    if page_table.ndim != 2 or page_table.shape[0] != b:
+        raise ValueError(
+            f"page_table must be ({b}, max_pages) int32 — one row per "
+            f"batch row — got {page_table.shape}"
+        )
+    n_pages = page_table.shape[1]
+    L = n_pages * ps
+    lengths = jnp.asarray(lengths)
+    if lengths.shape != (b,):
+        raise ValueError(
+            f"lengths must be ({b},) — one live length per batch row — "
+            f"got {lengths.shape}"
+        )
+    g = h // k_pages.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        from mmlspark_tpu.core.env import is_tpu
+
+        interpret = not is_tpu()
+    lengths = jnp.clip(lengths.astype(jnp.int32), 0, L)
+    page_table = page_table.astype(jnp.int32)
+
+    qb = _to_bh(q, 1)  # (B*H, 1, D)
+
+    def kv_im(bh, j, lens, pt):
+        # same last-live-block clamp as flash_decode, then the page
+        # table makes the surviving LOGICAL coordinate physical; the
+        # head coordinate picks the kv head inside the page
+        row = bh // h
+        length = lens[row]
+        last = jnp.maximum((length + ps - 1) // ps - 1, 0)
+        page = pt[row, jnp.minimum(j, last)]
+        return (page, (bh % h) // g, 0, 0)
+
+    out = pl.pallas_call(
+        partial(_paged_decode_kernel, scale=scale, blk=ps, heads=h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * h, n_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, d), lambda bh, j, lens, pt: (bh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, ps, d), kv_im,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, ps, d), kv_im,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, d), lambda bh, j, lens, pt: (bh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((SUBLANES, LANES), jnp.float32),  # running max
+                pltpu.VMEM((SUBLANES, LANES), jnp.float32),  # normalizer
+                pltpu.VMEM((SUBLANES, d), jnp.float32),      # accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        compiler_params=_DECODE_SEMANTICS,
+        interpret=bool(interpret),
+    )(lengths, page_table, qb, k_pages, v_pages)
+    return _from_bh(out, b, h, 1)

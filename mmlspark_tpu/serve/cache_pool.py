@@ -186,8 +186,22 @@ class SlotCachePool:
         # restore the free-slot convention (pos 0, dead) so the fused
         # decode block keeps every write of this row inside the leased
         # region and its flash-decode length reads as zero
-        self.positions = self._commit_slot(self.positions.at[slot].set(0))
-        self.live = self._commit_slot(self.live.at[slot].set(False))
+        self._commit_slot_pair(
+            self.positions.at[slot].set(0),
+            self.live.at[slot].set(False),
+        )
+
+    def _commit_slot_pair(self, positions, live) -> None:
+        """Rebind positions+live behind ONE pinned update — committing
+        them separately would issue two eager dispatches per
+        retire/admit, and the retire path runs once per finished
+        request."""
+        if self._slot_sharding is not None:
+            positions, live = jax.device_put(
+                (positions, live),
+                (self._slot_sharding, self._slot_sharding),
+            )
+        self.positions, self.live = positions, live
 
     # -- data path ---------------------------------------------------------
 
@@ -203,25 +217,28 @@ class SlotCachePool:
                 f"prefill length {length} exceeds the pool's cache_len "
                 f"{self.cache_len}"
             )
+        new_buffers = {}
         for name, (pk, pv) in self.buffers.items():
             ck, cv = prefill_cache[name]
             nk = pk.at[slot, :length].set(ck[0, :length].astype(pk.dtype))
             nv = pv.at[slot, :length].set(cv[0, :length].astype(pv.dtype))
-            if self._kv_shardings is not None:
-                # the eager scatter's output sharding is whatever GSPMD
-                # propagated from mixing the pool row with the prefill
-                # cache — re-commit to the pool's canonical sharding so
-                # the decode block's donated inputs never change
-                # signature (the compile-count pins depend on it)
-                sk, sv = self._kv_shardings[name]
-                nk, nv = jax.device_put(nk, sk), jax.device_put(nv, sv)
-            self.buffers[name] = (nk, nv)
+            new_buffers[name] = (nk, nv)
+        if self._kv_shardings is not None:
+            # the eager scatters' output shardings are whatever GSPMD
+            # propagated from mixing the pool rows with the prefill
+            # cache — re-commit to the pool's canonical shardings so
+            # the decode block's donated inputs never change signature
+            # (the compile-count pins depend on it). ONE device_put of
+            # the whole pytree, not one per K/V per block: the admit
+            # path runs this once per joiner.
+            new_buffers = jax.device_put(new_buffers, self._kv_shardings)
+        self.buffers = new_buffers
         # the slot's first decode step writes its first generated
         # token's K/V at position ``length`` (the prompt fills [0, P))
-        self.positions = self._commit_slot(
-            self.positions.at[slot].set(length)
+        self._commit_slot_pair(
+            self.positions.at[slot].set(length),
+            self.live.at[slot].set(True),
         )
-        self.live = self._commit_slot(self.live.at[slot].set(True))
 
     # -- accounting for telemetry ------------------------------------------
 

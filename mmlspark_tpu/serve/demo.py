@@ -34,7 +34,10 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              telemetry_dir: str | None = None,
              faults: str | None = None,
              slo: str | None = None,
-             trace_out: str | None = None) -> dict:
+             trace_out: str | None = None,
+             paged: bool = False,
+             page_size: int | None = None,
+             prefix_cache: bool = False) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line."""
     import jax
@@ -66,6 +69,9 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         # "Declaring SLOs"); None = undeclared
         slo=slo or None,
         retry_backoff_s=0.0,
+        # --paged/--page-size/--prefix-cache -> the paged KV-cache pool
+        # (docs/SERVING.md "Paged KV cache"); dense slot pool otherwise
+        paged=paged, page_size=page_size, prefix_cache=prefix_cache,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
     )

@@ -115,7 +115,10 @@ class ServeEngine:
                  retry_limit: int = 3,
                  retry_backoff_s: float = 0.02,
                  degrade_recover_ticks: int = 8,
-                 slo=None):
+                 slo=None,
+                 paged: bool = False, page_size: int | None = None,
+                 num_pages: int | None = None,
+                 prefix_cache: bool = False):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -173,8 +176,31 @@ class ServeEngine:
             shard_params(variables, self.mesh, TRANSFORMER_TP_RULES)
             if self.mesh is not None else variables
         )
-        self.pool = SlotCachePool(graph, variables, slots, cache_len,
-                                  mesh=self.mesh)
+        # paged KV cache (docs/SERVING.md "Paged KV cache"): the
+        # PagedCachePool virtualizes slot memory behind fixed-shape page
+        # stores + per-slot page tables — same compiled programs, same
+        # donation/sharding/compile-pin contracts, but HBM scales with
+        # pages actually mapped and shared prompt prefixes prefill once
+        if not paged and (
+            page_size is not None or num_pages is not None or prefix_cache
+        ):
+            raise FriendlyError(
+                "page_size/num_pages/prefix_cache configure the paged "
+                "KV cache; pass paged=True to enable it"
+            )
+        self._paged = bool(paged)
+        self._prefix_cache = bool(paged and prefix_cache)
+        if paged:
+            from mmlspark_tpu.serve.paging import PagedCachePool
+
+            self.pool = PagedCachePool(
+                graph, variables, slots, cache_len, mesh=self.mesh,
+                page_size=page_size, num_pages=num_pages,
+                prefix_cache=prefix_cache,
+            )
+        else:
+            self.pool = SlotCachePool(graph, variables, slots, cache_len,
+                                      mesh=self.mesh)
         self.metrics = ServeMetrics(
             graph.name, slots, decode_block=self.decode_block,
             mesh_shape=(
@@ -188,6 +214,8 @@ class ServeEngine:
                 self.pool.device_bytes_per_device()
             ),
         )
+        if paged:
+            self.metrics.attach_paging(self.pool.paging_stats)
         #: flight recorder (core/telemetry): one span per request
         #: lifecycle — queued -> admitted -> prefill[bucket] -> decode
         #: ticks -> finished/expired — dumpable as events.jsonl via the
@@ -293,6 +321,28 @@ class ServeEngine:
             registry=self.metrics.registry, recorder=self.recorder,
             expected_programs=self.num_prefill_buckets,
         )
+
+        # prefix-cache RESUME prefill (docs/SERVING.md "Paged KV
+        # cache"): a prompt sharing a cached prefix runs the forward
+        # over the REMAINDER only, against the prefix's gathered linear
+        # K/V. ``pos``/``last`` are traced, so programs are keyed by the
+        # remainder BUCKET alone — the same O(log cache_len) ceiling as
+        # full prefill.
+        def _resume(variables, ids, cache, pos, last):
+            logits, cache = _cached_apply(graph, variables, ids, cache,
+                                          pos)
+            cur = jax.lax.dynamic_slice_in_dim(
+                logits, last, 1, axis=1
+            )[:, 0]
+            return greedy_next(cur), cache
+
+        self._resume = None
+        if self._prefix_cache:
+            self._resume = RetraceWatchdog(
+                ProgramCountingJit(jax.jit(_resume)), "serve.resume",
+                registry=self.metrics.registry, recorder=self.recorder,
+                expected_programs=self.num_prefill_buckets,
+            )
         # the FUSED decode block (models.generate.make_decode_block):
         # lax.scan over t greedy micro-steps with the scan length
         # static (one program per ladder size) and the whole device
@@ -520,6 +570,15 @@ class ServeEngine:
         tests), however many distinct prompt lengths arrive."""
         return jit_cache_size(self._prefill)
 
+    @property
+    def resume_compile_count(self) -> int:
+        """How many prefix-resume programs have compiled — keyed by the
+        REMAINDER bucket, so bounded by ``num_prefill_buckets`` like
+        full prefill; 0 without the prefix cache."""
+        if self._resume is None:
+            return 0
+        return jit_cache_size(self._resume)
+
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
@@ -655,51 +714,121 @@ class ServeEngine:
                 )
                 first = None
                 attempts = 0
+                # prefix-cache probe: a hit swaps the full-prompt
+                # prefill for a REMAINDER resume against the cached
+                # prefix's pages (shared, refcounted — the prefix
+                # prefilled once, ever)
+                hit = (
+                    self.pool.prefix_lookup(seq, self.prefill_bucket)
+                    if self._prefix_cache else None
+                )
+                keep = 0
                 with annotate("serve.prefill"):
                     p = len(seq)
-                    bucket = self.prefill_bucket(p)
-                    padded = np.full((bucket,), self.pad_id, np.int32)
-                    padded[:p] = seq
-                    # device analytics: analyze each prefill bucket's
-                    # program ONCE, from abstract shapes — lowering
-                    # only, no backend compile, no device work, so the
-                    # prefill_compile_count pin is untouched
-                    family = f"prefill[{bucket}]"
-                    if self.metrics.perf.wants_program(family):
-                        self.metrics.perf.register_program(
-                            family,
-                            analyze_jit_cost(
-                                self._prefill._fn._fn, self.variables,
-                                padded[None], p - 1,
-                            ),
-                        )
-                    tp = time.perf_counter()
-                    while True:
-                        try:
-                            if self._faults is not None:
-                                self._faults.fire(
-                                    "serve.prefill", tick=tick,
-                                    request=req.id,
-                                )
-                            first_d, cache = self._prefill(
-                                self.variables,
-                                jnp.asarray(padded[None]), p - 1,
+                    if hit is not None:
+                        entry, keep = hit
+                        r = p - keep
+                        bucket = self.prefill_bucket(r)
+                        padded = np.full((bucket,), self.pad_id,
+                                         np.int32)
+                        padded[:r] = seq[keep:]
+                        # the resume input: the prefix's K/V gathered
+                        # back into a linear cache (an eager page read,
+                        # no donation — retries reuse it)
+                        lin = self.pool.gather_prefix(entry, keep)
+                        family = f"resume[{bucket}]"
+                        if self.metrics.perf.wants_program(family):
+                            self.metrics.perf.register_program(
+                                family,
+                                analyze_jit_cost(
+                                    self._resume._fn._fn,
+                                    self.variables, padded[None], lin,
+                                    keep, r - 1,
+                                ),
                             )
-                            # only the REAL prompt's K/V enter the
-                            # slot; the pad tail of the bucket cache is
-                            # dropped here
-                            self.pool.write_prefill(slot, cache, p)
-                            first = int(first_d[0])
-                            break
-                        except Exception as e:
-                            if is_resource_exhausted(e):
-                                self._note_oom(tick, "serve.prefill")
-                            elif not is_transient(e):
-                                raise
-                            attempts += 1
-                            if attempts > self._retry_limit:
+                        tp = time.perf_counter()
+                        while True:
+                            try:
+                                if self._faults is not None:
+                                    self._faults.fire(
+                                        "serve.prefill", tick=tick,
+                                        request=req.id,
+                                    )
+                                first_d, cache = self._resume(
+                                    self.variables,
+                                    jnp.asarray(padded[None]), lin,
+                                    keep, r - 1,
+                                )
+                                # map the shared pages FIRST (the
+                                # slot's references keep them alive
+                                # through any eviction the remainder
+                                # write triggers), then scatter only
+                                # the remainder [keep, p)
+                                self.pool.map_prefix(slot, entry, keep)
+                                self.pool.write_prefill(
+                                    slot, cache, p, start=keep
+                                )
+                                first = int(first_d[0])
                                 break
-                            self._backoff(attempts)
+                            except Exception as e:
+                                if is_resource_exhausted(e):
+                                    self._note_oom(tick,
+                                                   "serve.prefill")
+                                elif not is_transient(e):
+                                    raise
+                                attempts += 1
+                                if attempts > self._retry_limit:
+                                    break
+                                self._backoff(attempts)
+                    else:
+                        bucket = self.prefill_bucket(p)
+                        padded = np.full((bucket,), self.pad_id,
+                                         np.int32)
+                        padded[:p] = seq
+                        # device analytics: analyze each prefill
+                        # bucket's program ONCE, from abstract shapes —
+                        # lowering only, no backend compile, no device
+                        # work, so the prefill_compile_count pin is
+                        # untouched
+                        family = f"prefill[{bucket}]"
+                        if self.metrics.perf.wants_program(family):
+                            self.metrics.perf.register_program(
+                                family,
+                                analyze_jit_cost(
+                                    self._prefill._fn._fn,
+                                    self.variables, padded[None], p - 1,
+                                ),
+                            )
+                        tp = time.perf_counter()
+                        while True:
+                            try:
+                                if self._faults is not None:
+                                    self._faults.fire(
+                                        "serve.prefill", tick=tick,
+                                        request=req.id,
+                                    )
+                                first_d, cache = self._prefill(
+                                    self.variables,
+                                    jnp.asarray(padded[None]), p - 1,
+                                )
+                                # only the REAL prompt's K/V enter the
+                                # slot; the pad tail of the bucket
+                                # cache is dropped here
+                                self.pool.write_prefill(slot, cache, p)
+                                if self._prefix_cache:
+                                    self.pool.prefix_insert(slot, seq)
+                                first = int(first_d[0])
+                                break
+                            except Exception as e:
+                                if is_resource_exhausted(e):
+                                    self._note_oom(tick,
+                                                   "serve.prefill")
+                                elif not is_transient(e):
+                                    raise
+                                attempts += 1
+                                if attempts > self._retry_limit:
+                                    break
+                                self._backoff(attempts)
                 if first is None:
                     # retries exhausted: quarantine THIS request only —
                     # the admit loop moves on to the next joiner
@@ -717,7 +846,7 @@ class ServeEngine:
                 if span is not None:
                     span.event(
                         "prefill", tick=tick, bucket=bucket,
-                        ms=round(prefill_s * 1e3, 3),
+                        ms=round(prefill_s * 1e3, 3), reused=keep,
                     )
                 # the dispatch interval ends at prefill's EXISTING
                 # host sync (int(first_d[0]) above) — analytics adds
@@ -826,6 +955,14 @@ class ServeEngine:
             try:
                 with annotate("serve.decode"):
                     td = time.perf_counter()
+                    # paged pool: pre-map every page this block can
+                    # write (the tables are read-only DURING the block,
+                    # preserving its one host sync). Page exhaustion
+                    # raises RESOURCE_EXHAUSTED inside this try, so it
+                    # walks the same ladder as a real allocator OOM —
+                    # and the preemption it can trigger FREES pages.
+                    if self._paged:
+                        self.pool.ensure_decode_pages(pre_pos, t_block)
                     # the fault hook fires BEFORE the dispatch: an
                     # injected failure never consumes the donated
                     # buffers, so retrying with the same pool state is
@@ -1043,7 +1180,7 @@ class ServeEngine:
                 "deadline_tick": req.deadline_tick,
                 "submit_tick": req.submit_tick,
             })
-        return {
+        snap = {
             "version": 1,
             "model": self.graph.name,
             "cache_len": self.cache_len,
@@ -1053,6 +1190,13 @@ class ServeEngine:
             "active": active,
             "queued": queued,
         }
+        if self._paged:
+            # paging plane (page tables, refcounts, prefix entries):
+            # informational — restore() re-prefills and rebuilds the
+            # mappings from scratch, but the crash dump stays auditable
+            # (refcount totals vs mapped pages)
+            snap["paging"] = self.pool.snapshot()
+        return snap
 
     @classmethod
     def restore(cls, snapshot: dict, graph, variables,

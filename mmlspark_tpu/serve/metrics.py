@@ -117,12 +117,49 @@ class ServeMetrics:
         #: rolling-window SLO monitor (attach_slo); None -> undeclared
         self.slo: SloMonitor | None = None
         self._slo_shed_ticks = r.counter("serve.slo_shed_ticks")
+        #: paged KV-cache stats provider (attach_paging); None -> dense
+        #: pool, the paging keys report inert defaults so the flat
+        #: schema stays fixed across pool kinds
+        self._paging_provider = None
 
     def attach_slo(self, monitor: SloMonitor) -> None:
         """Feed the monitor from this plane's hooks: TTFT per first
         token, per-token latency per decode dispatch, ok/error per
         terminal status."""
         self.slo = monitor
+
+    def attach_paging(self, provider) -> None:
+        """Wire the paged pool's ``paging_stats`` callable
+        (serve/paging.py) in; ``to_dict`` then reports live allocator /
+        prefix-cache / copy-on-extend figures instead of the dense
+        defaults (docs/OBSERVABILITY.md "Paged KV cache")."""
+        self._paging_provider = provider
+
+    def _paging_dict(self) -> dict:
+        """The paging plane's flat keys (schema-gated in
+        tools/check_metrics_schema.py) — ALWAYS present: dense engines
+        report zeros (and ``page_utilization: None``), so downstream
+        consumers never branch on key existence."""
+        if self._paging_provider is not None:
+            stats = dict(self._paging_provider())
+        else:
+            stats = {}
+        return {
+            "page_size": int(stats.get("page_size", 0)),
+            "pages_total": int(stats.get("pages_total", 0)),
+            "pages_free": int(stats.get("pages_free", 0)),
+            "page_utilization": stats.get("page_utilization"),
+            "prefix_cache_hits_total": int(
+                stats.get("prefix_cache_hits_total", 0)
+            ),
+            "prefix_cache_entries": int(
+                stats.get("prefix_cache_entries", 0)
+            ),
+            "cow_copies_total": int(stats.get("cow_copies_total", 0)),
+            "prefix_tokens_saved_total": int(
+                stats.get("prefix_tokens_saved_total", 0)
+            ),
+        }
 
     def record_slo_shed(self) -> None:
         """One tick during which SLO shedding suppressed admissions."""
@@ -370,6 +407,10 @@ class ServeMetrics:
             "mesh_shape": dict(self.mesh_shape),
             "mesh_devices": self.mesh_devices,
             "cache_pool_bytes_per_device": self.cache_pool_bytes_per_device,
+            # paged KV cache (docs/SERVING.md "Paged KV cache";
+            # schema-gated): allocator occupancy, prefix-cache traffic,
+            # copy-on-extend count — inert defaults on dense pools
+            **self._paging_dict(),
             # resilience plane (docs/SERVING.md "Failure semantics";
             # schema-gated): fault-handling activity and whether the
             # engine is currently degraded

@@ -93,8 +93,8 @@ def test_scratch_merge_roundtrip_and_missing_groups(monkeypatch, tmp_path):
     line = bench._final_line(bench._scratch_load(), attempt=1)
     assert set(line["missing_metrics"]) == {
         "stage", "resnet50", "train", "trees", "flash", "flash_long",
-        "int8_serving", "feed_synth", "decode", "serve", "serve_sharded",
-        "serve_faults",
+        "int8_serving", "feed_synth", "decode", "serve", "serve_paged",
+        "serve_sharded", "serve_faults",
     }
     # merge is a real file round-trip: a fresh load sees the update
     with open(os.environ["MMLTPU_BENCH_SCRATCH"], encoding="utf-8") as f:

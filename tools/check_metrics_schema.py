@@ -115,6 +115,17 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "slo_violations_total": (int,),
     "slo_shed_ticks_total": (int,),
     "slo": (dict,),
+    # paged KV cache (docs/SERVING.md "Paged KV cache"): always present
+    # — a dense-pool run reports the int keys as 0 and
+    # page_utilization as null, a --paged run populates all of them
+    "page_size": (int,),
+    "pages_total": (int,),
+    "pages_free": (int,),
+    "page_utilization": NUM + (type(None),),
+    "prefix_cache_hits_total": (int,),
+    "prefix_cache_entries": (int,),
+    "cow_copies_total": (int,),
+    "prefix_tokens_saved_total": (int,),
     # demo envelope
     "n_requests": (int,),
     "decode_compiles": (int,),
@@ -269,6 +280,11 @@ def main() -> None:
             "serve", "--demo", "--slots", "2",
             "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
             "--mesh", "data=2,model=2",
+            # the PAGED pool (docs/SERVING.md "Paged KV cache"): the
+            # same engine contract plus the paging metric keys in
+            # populated form — page_utilization must be a number here,
+            # not the dense pool's null
+            "--paged",
             "--telemetry-dir", tdir,
             # generous targets: the SLO plane runs (declared state,
             # window arithmetic, per-tick evaluation) without actually
@@ -309,6 +325,15 @@ def main() -> None:
             )
         if not stdout_metrics.get("cache_pool_bytes_per_device", 0) > 0:
             fail("stdout: cache_pool_bytes_per_device must be positive")
+        for key in ("page_size", "pages_total"):
+            if not stdout_metrics.get(key, 0) > 0:
+                fail(f"stdout: a --paged run must report positive {key}")
+        if not isinstance(stdout_metrics.get("page_utilization"), NUM):
+            fail(
+                "stdout: a --paged run must report numeric "
+                f"page_utilization, got "
+                f"{stdout_metrics.get('page_utilization')!r}"
+            )
 
         mpath = os.path.join(tdir, "metrics.json")
         if not os.path.exists(mpath):

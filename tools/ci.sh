@@ -93,6 +93,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "serving resilience gate (fault injection / quarantine / chaos soak)"
   python -m pytest tests/test_serve_faults.py -q
 
+  step "paged KV-cache gate (allocator / prefix cache / paged-decode parity)"
+  python -m pytest tests/test_paging.py -q
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
