@@ -259,9 +259,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     sp.add_argument(
         "--page-size", type=int, default=None, metavar="P",
-        help="tokens per KV page (requires --paged; >= 8, dividing "
-        "cache_len; default: smallest such divisor). Doubles as the "
-        "paged decode kernel's KV block",
+        help="tokens per KV page (requires --paged; a multiple of 8 "
+        "dividing cache_len; default: smallest such multiple). "
+        "Doubles as the paged decode kernel's KV block",
     )
     sp.add_argument(
         "--prefix-cache", action="store_true",

@@ -719,7 +719,9 @@ class ServeEngine:
                 # prefix's pages (shared, refcounted — the prefix
                 # prefilled once, ever)
                 hit = (
-                    self.pool.prefix_lookup(seq, self.prefill_bucket)
+                    self.pool.prefix_lookup(
+                        seq, self.prefill_bucket, slot=slot
+                    )
                     if self._prefix_cache else None
                 )
                 keep = 0
@@ -764,7 +766,19 @@ class ServeEngine:
                                 # through any eviction the remainder
                                 # write triggers), then scatter only
                                 # the remainder [keep, p)
-                                self.pool.map_prefix(slot, entry, keep)
+                                if not self.pool.map_prefix(
+                                    slot, entry, keep
+                                ):
+                                    # entry evicted since the lookup
+                                    # (a prior attempt's own page
+                                    # pressure): its pages may already
+                                    # be free or reallocated, so the
+                                    # remainder cache cannot seed the
+                                    # slot — fall back to the full
+                                    # prefill below
+                                    hit = None
+                                    keep = 0
+                                    break
                                 self.pool.write_prefill(
                                     slot, cache, p, start=keep
                                 )
@@ -780,7 +794,10 @@ class ServeEngine:
                                 if attempts > self._retry_limit:
                                     break
                                 self._backoff(attempts)
-                    else:
+                    if hit is None:
+                        # the miss path — also the landing spot for a
+                        # stale-prefix fallback above (attempts carry
+                        # over into this loop's retry budget)
                         bucket = self.prefill_bucket(p)
                         padded = np.full((bucket,), self.pad_id,
                                          np.int32)

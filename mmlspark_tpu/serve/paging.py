@@ -43,8 +43,13 @@ Host-side accounting:
   prefix maps those pages instead of recomputing them —
   COPY-ON-EXTEND, a slot privatizes a shared page only when its write
   frontier enters it (``refcount > 1`` at ``_ensure_writable`` time).
-  Page pressure evicts least-recently-used entries first; if the free
-  list is still empty the allocator raises the runtime's
+  Sharing is shard-local to keep the placement contract: a hit from a
+  slot on another data shard copies the entry's pages onto the slot's
+  shard instead of mapping them remotely (the prefill FLOPs are still
+  saved). Page pressure evicts the PRESSURED SHARD's least-recently-
+  used entries first (other shards' entries free nothing there and
+  survive); if the free list is still empty the allocator raises the
+  runtime's
   ``RESOURCE_EXHAUSTED`` spelling (:class:`~mmlspark_tpu.core.faults.
   ResourceExhausted`), which the engine's existing degradation ladder
   (PR 7) absorbs: smaller decode blocks, tighter admission, preemption
@@ -82,14 +87,23 @@ MIN_PAGE_SIZE = 8
 
 
 def default_page_size(cache_len: int) -> int:
-    """Smallest divisor of ``cache_len`` in [8, cache_len]: small pages
-    maximize how much of the pool short requests leave free (the point
-    of paging), and the kernel's length clamp already prices the extra
-    grid steps at zero for dead pages."""
-    for cand in range(MIN_PAGE_SIZE, cache_len + 1):
+    """Smallest multiple of the sublane tile in [8, cache_len] dividing
+    ``cache_len``: small pages maximize how much of the pool short
+    requests leave free (the point of paging), the kernel's length
+    clamp already prices the extra grid steps at zero for dead pages,
+    and ``paged_flash_decode`` only tiles pages whose ``(page_size,
+    d)`` face is whole sublanes. Raises at build time — not at the
+    first decode dispatch — when ``cache_len`` admits no such page
+    size."""
+    for cand in range(MIN_PAGE_SIZE, cache_len + 1, MIN_PAGE_SIZE):
         if cache_len % cand == 0:
             return cand
-    return cache_len
+    raise FriendlyError(
+        f"cache_len ({cache_len}) has no page size that is a multiple "
+        f"of {MIN_PAGE_SIZE} (the TPU sublane tile — the paged decode "
+        "kernel's KV-block unit) and divides it evenly; round "
+        f"cache_len to a multiple of {MIN_PAGE_SIZE} to serve paged"
+    )
 
 
 @dataclasses.dataclass
@@ -144,6 +158,13 @@ class PagedCachePool:
                 f"page_size must be >= {MIN_PAGE_SIZE} (the TPU sublane "
                 f"tile — it doubles as the paged decode kernel's KV "
                 f"block), got {page_size}"
+            )
+        if page_size % MIN_PAGE_SIZE:
+            raise FriendlyError(
+                f"page_size ({page_size}) must be a multiple of "
+                f"{MIN_PAGE_SIZE}: paged_flash_decode tiles each page's "
+                "(page_size, d) face in whole TPU sublanes and rejects "
+                "ragged pages at dispatch time"
             )
         if cache_len % page_size:
             raise FriendlyError(
@@ -239,6 +260,8 @@ class PagedCachePool:
         self.prefix_tokens_saved = 0
         self.prefix_evictions = 0
         self.cow_copies = 0
+        #: cross-shard hits localized by page copy (mesh only)
+        self.prefix_shard_copies = 0
 
         # -- device arrays ---------------------------------------------
         self.buffers = {}
@@ -290,6 +313,13 @@ class PagedCachePool:
     def _trash_page(self, shard: int) -> int:
         return shard * self._pages_per_shard
 
+    def _entry_shard(self, entry: _PrefixEntry) -> int:
+        """The data shard holding ALL of an entry's pages:
+        ``prefix_insert`` registers one slot's pages (allocated on that
+        slot's shard) and ``map_prefix`` copies cross-shard pages local
+        before a slot maps them, so an entry never straddles shards."""
+        return self._shard_of_page(entry.pages[0])
+
     # -- page allocator ----------------------------------------------------
 
     def _alloc_page(self, shard: int) -> int:
@@ -321,12 +351,21 @@ class PagedCachePool:
 
     def _evict_prefix_entries(self, shard: int) -> None:
         """Free-list pressure valve: drop least-recently-used prefix
-        entries until ``shard`` has a free page (or nothing is left to
-        evict). Pages still mapped by active slots survive their
-        entry's eviction — the refcount only reaches zero once the last
-        slot frees too."""
-        while self._prefix and not self._free_pages[shard]:
-            key = min(self._prefix, key=lambda k: self._prefix[k].last_used)
+        entries whose pages live ON ``shard`` until it has a free page
+        (or no remaining entry can free one there). Entries on other
+        shards are never touched — evicting them frees nothing on the
+        pressured shard, so doing so would wipe unrelated shards'
+        cached prefixes and still exhaust. Pages still mapped by active
+        slots survive their entry's eviction — the refcount only
+        reaches zero once the last slot frees too."""
+        while not self._free_pages[shard]:
+            local = [
+                k for k, e in self._prefix.items()
+                if self._entry_shard(e) == shard
+            ]
+            if not local:
+                return
+            key = min(local, key=lambda k: self._prefix[k].last_used)
             entry = self._prefix.pop(key)
             for page in entry.pages:
                 self._decref(page)
@@ -538,27 +577,35 @@ class PagedCachePool:
 
     # -- prefix cache ------------------------------------------------------
 
-    def prefix_lookup(self, seq, bucket_fn):
+    def prefix_lookup(self, seq, bucket_fn, slot: int | None = None):
         """Best reusable prefix for ``seq``: the cached entry sharing
         the longest common prefix, trimmed to ``keep`` positions such
         that (a) at least one remainder token is left to prefill (its
         logits seed decode), and (b) the remainder's padded bucket
         still fits the linear resume cache (``keep + bucket_fn(len -
         keep) <= cache_len`` — a clamped ``dynamic_update_slice`` would
-        corrupt the shared prefix otherwise). Returns ``(entry, keep)``
-        or None when nothing covers at least one page."""
+        corrupt the shared prefix otherwise). With ``slot`` given,
+        entries whose pages live on the slot's data shard win coverage
+        ties — a same-shard hit maps shared pages for free where a
+        cross-shard hit pays :meth:`map_prefix`'s localizing page
+        copies. Returns ``(entry, keep)`` or None when nothing covers
+        at least one page."""
         if not self._prefix:
             return None
+        shard = None if slot is None else self._shard_of_slot(slot)
         seq = np.asarray(seq, np.int32)
-        best, best_c = None, 0
+        best, best_c, best_local = None, 0, False
         for entry in self._prefix.values():
             m = min(int(seq.size), entry.length)
-            if m <= best_c:
+            if m < best_c:
                 continue
             neq = np.nonzero(seq[:m] != entry.prompt[:m])[0]
             c = int(neq[0]) if neq.size else m
-            if c > best_c:
-                best, best_c = entry, c
+            local = shard is None or self._entry_shard(entry) == shard
+            if c > best_c or (
+                c == best_c and c > 0 and local and not best_local
+            ):
+                best, best_c, best_local = entry, c, local
         keep = min(best_c, int(seq.size) - 1)
         while (
             keep >= self.page_size
@@ -570,26 +617,56 @@ class PagedCachePool:
         return best, keep
 
     def map_prefix(self, slot: int, entry: _PrefixEntry,
-                   keep: int) -> None:
-        """Map the entry's pages covering ``[0, keep)`` into ``slot``
-        (shared: refcounts rise, nothing is copied — the prefix
-        prefilled ONCE). Any mappings the slot already holds are
-        released first, making a faulted admit's retry idempotent."""
+                   keep: int) -> bool:
+        """Map the entry's pages covering ``[0, keep)`` into ``slot``.
+        Pages on the slot's data shard are SHARED (refcounts rise,
+        nothing is copied — the prefix prefilled ONCE); pages on
+        another shard are copied onto local pages first, preserving the
+        per-page placement contract while still skipping the prefix's
+        prefill FLOPs. Any mappings the slot already holds are released
+        first, making a faulted admit's retry idempotent.
+
+        Returns False — mapping nothing, leaving the slot's existing
+        mappings untouched — when the entry is STALE: evicted since the
+        lookup (a prior attempt's own page pressure can do that, and
+        eviction drops the entry's page references). Mapping a stale
+        entry could resurrect pages already on the free list — mapped
+        and allocatable at once — so the caller must fall back to a
+        full prefill instead. For a registered entry the entry's own
+        references pin every page above zero through the re-map, so the
+        release below can never free them."""
         if slot not in self._leased:
             raise FriendlyError(f"slot {slot} is not leased")
+        if self._prefix.get(entry.prompt.tobytes()) is not entry:
+            return False
         self._release_mappings(slot)
+        shard = self._shard_of_slot(slot)
         n = -(-keep // self.page_size)  # ceil
+        copied = False
         for i in range(n):
             phys = entry.pages[i]
-            self._refcount[phys] += 1
-            self._pt_host[slot, i] = phys
-        self._npages[slot] = n
-        self._pt_dirty = True
+            if self._shard_of_page(phys) == shard:
+                self._refcount[phys] += 1
+                self._pt_host[slot, i] = phys
+            else:
+                # localize: an allocator raise here leaves pages [0, i)
+                # accounted to the slot (npages tracks the loop), so a
+                # retry or free releases them
+                page = self._alloc_page(shard)
+                self._copy_page(phys, page)
+                self._pt_host[slot, i] = page
+                self.prefix_shard_copies += 1
+                copied = True
+            self._npages[slot] = i + 1
+            self._pt_dirty = True
         self._use_counter += 1
         entry.last_used = self._use_counter
         self.prefix_hits += 1
         self.prefix_tokens_saved += keep
+        if copied:
+            self._commit_kv()
         self._commit_pt()
+        return True
 
     def gather_prefix(self, entry: _PrefixEntry, keep: int) -> dict:
         """Linearize the entry's first ``keep`` positions into fresh

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.faults import Fault, FaultInjector, ResourceExhausted
 from mmlspark_tpu.models import build_model, generate
+from mmlspark_tpu.parallel.mesh import make_mesh
 from mmlspark_tpu.serve import ServeEngine
 from mmlspark_tpu.serve.paging import (
     MIN_PAGE_SIZE,
@@ -98,19 +99,30 @@ def test_default_page_size():
     assert default_page_size(64) == 8
     assert default_page_size(32) == 8
     assert default_page_size(40) == 8
-    assert default_page_size(20) == 10  # 8 and 9 don't divide
     assert default_page_size(8) == 8
-    for cl in (16, 24, 48, 96, 100):
+    assert default_page_size(48) == 8
+    for cl in (16, 24, 48, 96, 80):
         ps = default_page_size(cl)
         assert ps >= MIN_PAGE_SIZE and cl % ps == 0
+        assert ps % MIN_PAGE_SIZE == 0  # the kernel's sublane contract
+    # no multiple of 8 divides these: refuse at BUILD time — the old
+    # behavior returned e.g. 10 for 20 and every paged decode dispatch
+    # then died on the kernel's sublane check
+    for cl in (20, 36, 100):
+        with pytest.raises(FriendlyError, match="multiple"):
+            default_page_size(cl)
 
 
 def test_pool_and_engine_flag_validation(lm):
     m, v, _ = lm
     with pytest.raises(FriendlyError, match="page_size"):
         _pool(m, v, page_size=4)
+    with pytest.raises(FriendlyError, match="multiple"):
+        _pool(m, v, page_size=12)  # not sublane-tileable by the kernel
     with pytest.raises(FriendlyError, match="divide"):
-        _pool(m, v, page_size=12)  # 12 does not divide 32
+        _pool(m, v, page_size=24)  # 24 does not divide 32
+    with pytest.raises(FriendlyError, match="multiple"):
+        _pool(m, v, cache_len=20)  # no valid default page size
     with pytest.raises(FriendlyError, match="trash page"):
         _pool(m, v, num_pages=1)
     # paging knobs without paged=True must refuse loudly, not silently
@@ -193,6 +205,123 @@ def test_pool_exhaustion_raises_resource_exhausted(lm):
     assert pool.pages_free == 0
     pool.free(slot)
     assert pool.pages_free == pool.pages_allocatable
+
+
+def test_map_prefix_stale_entry_refuses_resurrection(lm):
+    """The resume-retry hazard: attempt 1 maps a prefix entry, the
+    remainder write's page pressure evicts that very entry, and the
+    retry re-enters map_prefix. The stale re-map must map NOTHING and
+    return False (the engine then falls back to a full prefill) — the
+    old path released the slot's references, dropped the pages onto the
+    free list, and re-mapped them anyway, leaving a page mapped and
+    allocatable at once."""
+    m, v, _ = lm
+    pool = _pool(m, v, prefix_cache=True)
+    seq = np.arange(14, dtype=np.int32) % 8
+    s0 = pool.lease()
+    pool.write_prefill(s0, _fake_prefill(pool, 14), 14)
+    pool.prefix_insert(s0, seq)
+    pool.free(s0)
+    entry, keep = pool.prefix_lookup(seq, bucket_fn=lambda n: n)
+    s1 = pool.lease()
+    assert pool.map_prefix(s1, entry, keep) is True  # attempt 1
+    # mid-attempt eviction, exactly as _evict_prefix_entries does it:
+    # the entry leaves the cache and drops its page references (the
+    # pages survive on slot 1's references alone)
+    assert pool._prefix.pop(seq.tobytes()) is entry
+    for page in entry.pages:
+        pool._decref(page)
+    assert pool.map_prefix(s1, entry, keep) is False  # stale retry
+    # invariant: no page is simultaneously mapped and on a free list
+    snap = pool.snapshot()
+    free = {p for f in pool._free_pages for p in f}
+    for s in range(pool.num_slots):
+        mapped = set(snap["page_table"][s][:snap["npages"][s]])
+        assert not (free & mapped)
+    # slot 1 kept its attempt-1 mappings; retirement returns every
+    # page without a refcount underflow
+    pool.free(s1)
+    assert pool.pages_free == pool.pages_allocatable
+    assert sum(pool.snapshot()["refcounts"]) == 0
+
+
+# -- shard locality under a mesh -------------------------------------------
+
+
+def test_prefix_eviction_is_shard_local(lm):
+    """Pressure on one data shard evicts only that shard's prefix
+    entries: evicting another shard's entry frees nothing on the
+    pressured shard, so the old global-LRU sweep wiped unrelated
+    shards' cached prefixes and still exhausted."""
+    m, v, _ = lm
+    pool = PagedCachePool(m, v, slots=4, cache_len=32,
+                          mesh=make_mesh({"data": 2}), num_pages=6,
+                          prefix_cache=True)
+    # per shard: 1 trash + 2 allocatable pages
+    s0, s1, s2 = pool.lease(), pool.lease(), pool.lease()
+    a = np.arange(16, dtype=np.int32) % 8
+    b = (a + 1) % 8
+    pool.write_prefill(s0, _fake_prefill(pool, 16, seed=1), 16)  # shard 0
+    pool.prefix_insert(s0, a)
+    pool.write_prefill(s2, _fake_prefill(pool, 16, seed=2), 16)  # shard 1
+    pool.prefix_insert(s2, b)
+    for s in (s0, s1, s2):
+        pool.free(s)
+    assert pool.pages_free == 0  # both shards fully pinned by entries
+    p0 = pool._alloc_page(0)  # pressure on shard 0
+    assert pool.prefix_evictions == 1
+    assert pool._shard_of_page(p0) == 0
+    p1 = pool._alloc_page(0)  # the evicted entry's second page
+    # nothing local left to evict: raise rather than wipe shard 1
+    with pytest.raises(ResourceExhausted, match="exhausted"):
+        pool._alloc_page(0)
+    snap = pool.snapshot()
+    assert [e["prompt"] for e in snap["prefix_entries"]] == [b.tolist()]
+    pool._decref(p0)
+    pool._decref(p1)
+    assert pool.pages_free == pool.pages_allocatable - _entry_pages(pool)
+
+
+def test_prefix_cross_shard_hit_copies_pages_local(lm):
+    """A hit from a slot on another data shard localizes the entry's
+    pages by copy instead of mapping them remotely — the per-page
+    placement contract (every page a slot maps lives on the slot's
+    shard) holds, the bytes match, and the entry's own pages are
+    untouched."""
+    m, v, _ = lm
+    pool = PagedCachePool(m, v, slots=4, cache_len=32,
+                          mesh=make_mesh({"data": 2}),
+                          prefix_cache=True)
+    seq = np.arange(12, dtype=np.int32) % 8
+    s0 = pool.lease()  # slot 0 -> shard 0
+    pool.write_prefill(s0, _fake_prefill(pool, 12, seed=5), 12)
+    pool.prefix_insert(s0, seq)
+    s1, s2 = pool.lease(), pool.lease()  # slot 2 -> shard 1
+    hit = pool.prefix_lookup(seq, bucket_fn=lambda n: n, slot=s2)
+    assert hit is not None
+    entry, keep = hit
+    assert pool.map_prefix(s2, entry, keep) is True
+    n = -(-keep // pool.page_size)
+    snap = pool.snapshot()
+    mapped = snap["page_table"][s2][:n]
+    lo = pool._pages_per_shard
+    assert all(lo <= pg < 2 * lo for pg in mapped), mapped
+    assert pool.prefix_shard_copies == n
+    for name, (pk, pv, _pt) in pool.buffers.items():
+        for i, pg in enumerate(mapped):
+            src = entry.pages[i]
+            np.testing.assert_array_equal(
+                np.asarray(pk[pg], np.float32),
+                np.asarray(pk[src], np.float32), err_msg=name)
+            np.testing.assert_array_equal(
+                np.asarray(pv[pg], np.float32),
+                np.asarray(pv[src], np.float32), err_msg=name)
+    # localized copies are private (refcount 1), the entry's pages
+    # keep only their original references
+    assert all(snap["refcounts"][pg] == 1 for pg in mapped)
+    for s in (s2, s1, s0):
+        pool.free(s)
+    assert pool.pages_free == pool.pages_allocatable - _entry_pages(pool)
 
 
 # -- engine parity: paged == dense == generate() ---------------------------
