@@ -120,6 +120,7 @@ _GROUPS = {
     "serve_sharded": ("serve_sharded",),
     "serve_faults": ("serve_faults",),
     "serve_paged": ("serve_paged",),
+    "serve_supervisor": ("serve_supervisor",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -1053,6 +1054,143 @@ def bench_serve_paged(jax) -> dict:
     return {"serve_paged": out}
 
 
+def bench_serve_supervisor(jax) -> dict:
+    """Replicated-serving control-plane costs (docs/SERVING.md
+    "Replicated serving"). Three figures:
+
+    - ``tokens_per_sec_n1`` vs ``tokens_per_sec_n2``: the SAME traffic
+      through one bare ``ServeEngine`` and through a 2-replica
+      ``ReplicaSet`` — the supervisor only touches the host-side
+      routing table between ticks, so ``routing_overhead_pct`` should
+      sit near the noise floor (replicas share the backend here, so
+      this prices the facade, not device scaling);
+    - ``failover``: a replica-pinned mid-decode kill with a periodic
+      snapshot cadence — ``recover_ms`` is the inline
+      park/restore/reconcile span (flight-recorder ``failover`` ->
+      ``restored`` timestamps) and ``extra_ticks`` the replayed decode
+      work vs the clean run, the snapshot-cadence trade-off in numbers;
+    - ``hedging``: every request duplicated (``hedge_ms=0``) vs none —
+      request-wall p99 and the wasted-token bill for the tail-latency
+      insurance."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve import Fault, FaultInjector, ReplicaSet, ServeEngine
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 32, 2, 2)
+    )
+    slots, n_req, max_new = (8, 8, 33) if full else (4, 8, 9)
+    p = 8
+    cache_len = 128 if full else 32
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len,
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, p), jnp.int32)
+    )
+    prompts = [
+        row.astype(np.int32)
+        for row in np.random.default_rng(13).integers(
+            0, vocab, size=(n_req, p)
+        )
+    ]
+    kwargs = dict(slots=slots, cache_len=cache_len, max_queue=n_req,
+                  decode_block=8, retry_backoff_s=0.0)
+
+    def drive(target) -> dict:
+        for pr in prompts:
+            target.submit(pr, max_new_tokens=max_new)
+        return target.run()
+
+    def timed_tps(make) -> float:
+        target = make()
+        drive(target)  # warm-up: compiles each replica's ladder once
+        secs = min(_timed(lambda: drive(target)) for _ in range(3))
+        return n_req * max_new / secs
+
+    tps_n1 = timed_tps(lambda: ServeEngine(graph, variables, **kwargs))
+    tps_n2 = timed_tps(
+        lambda: ReplicaSet(graph, variables, replicas=2, **kwargs)
+    )
+
+    # failover drill: clean run first for the tick baseline, then the
+    # same traffic with replica 0 killed mid-decode-block. Small decode
+    # blocks keep the run multi-tick so a tick-pinned kill lands while
+    # the replica is still decoding
+    drill_kwargs = dict(kwargs, decode_block=2)
+    clean = ReplicaSet(graph, variables, replicas=2,
+                       snapshot_every_ticks=2, **drill_kwargs)
+    drive(clean)
+    inj = FaultInjector([Fault("serve.decode", "kill", tick=2,
+                               replica=0)])
+    faulted = ReplicaSet(graph, variables, replicas=2,
+                         snapshot_every_ticks=2, faults=inj,
+                         **drill_kwargs)
+    results = drive(faulted)
+    if faulted.replica_failovers_total != 1:
+        raise RuntimeError(
+            f"failover drill expected exactly 1 failover, got "
+            f"{faulted.replica_failovers_total}"
+        )
+    if sorted(r.status for r in results.values()) != ["completed"] * n_req:
+        raise RuntimeError(
+            "failover drill must complete every request, got "
+            f"{[r.status for r in results.values()]}"
+        )
+    evs = {ev["name"]: ev["t"] for ev in faulted.recorder.events()
+           if ev["name"] in ("failover", "restored")}
+    recover_ms = (evs["restored"] - evs["failover"]) * 1e3
+
+    # hedging: duplicate every request (hedge_ms=0) vs never. Multi-tick
+    # decode (small blocks) leaves requests open long enough to hedge,
+    # and half the traffic leaves slot headroom for the duplicates to
+    # actually decode (the interesting case: real wasted work)
+    def wall_p99(hedge_ms):
+        rs = ReplicaSet(graph, variables, replicas=2,
+                        hedge_ms=hedge_ms, **drill_kwargs)
+        drive(rs)  # warm-up: compiles + absorbs its own hedges
+        h0, w0 = rs.hedges_total, rs.hedge_wasted_tokens_total
+        gids = [rs.submit(pr, max_new_tokens=max_new)
+                for pr in prompts[: n_req // 2]]
+        res = rs.run()
+        walls = [res[g].wall_s for g in gids]
+        return (float(np.percentile(walls, 99)) * 1e3,
+                rs.hedges_total - h0, rs.hedge_wasted_tokens_total - w0)
+    p99_plain, _, _ = wall_p99(None)
+    p99_hedged, n_hedges, n_waste = wall_p99(0.0)
+
+    out: dict = {
+        "tokens_per_sec_n1": round(tps_n1, 1),
+        "tokens_per_sec_n2": round(tps_n2, 1),
+        "routing_overhead_pct": round((tps_n1 / tps_n2 - 1) * 100, 2),
+        "failover": {
+            "recover_ms": round(recover_ms, 2),
+            "extra_ticks": faulted.tick - clean.tick,
+            "snapshot_every_ticks": 2,
+            "snapshots_total": sum(
+                faulted.engine(i).metrics.snapshots_total
+                for i in range(2)
+            ),
+        },
+        "hedging": {
+            "request_wall_p99_ms_no_hedge": round(p99_plain, 2),
+            "request_wall_p99_ms_hedged": round(p99_hedged, 2),
+            "hedges": n_hedges,
+            "hedge_wasted_tokens": n_waste,
+        },
+        "model": {"vocab": vocab, "d_model": d_model, "heads": heads,
+                  "depth": depth, "requests": n_req, "prompt": p,
+                  "max_new": max_new, "slots": slots},
+        "timing": ("full drive per target, warm-up then best-of-3 for "
+                   "throughput; failover/hedging from single "
+                   "instrumented runs"),
+    }
+    return {"serve_supervisor": out}
+
+
 def bench_serve_sharded() -> dict:
     """Mesh-sharded serving scaling sweep (docs/SERVING.md "Sharded
     serving"): the SAME synthetic-traffic demo as the ``serve`` group,
@@ -1551,6 +1689,7 @@ def run(attempt: int) -> dict:
         "serve": lambda: bench_serve(jax),
         "serve_faults": lambda: bench_serve_faults(jax),
         "serve_paged": lambda: bench_serve_paged(jax),
+        "serve_supervisor": lambda: bench_serve_supervisor(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),

@@ -85,6 +85,8 @@ def cmd_serve(args) -> int:
         paged=args.paged,
         page_size=args.page_size,
         prefix_cache=args.prefix_cache,
+        replicas=args.replicas,
+        hedge_ms=args.hedge_ms,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -270,6 +272,22 @@ def main(argv: list[str] | None = None) -> int:
         "prompt hash, later prompts map them refcounted and prefill "
         "only the remainder (copy-on-extend on divergence); the JSON "
         "line grows prefix_cache_hits_total / cow_copies_total",
+    )
+    sp.add_argument(
+        "--replicas", type=int, default=1, metavar="N",
+        help="serve through a ReplicaSet of N health-checked engine "
+        "replicas (one mesh/slot pool each, shared params) with "
+        "snapshot-based failover and zero-loss drain; the JSON line "
+        "becomes the supervisor's metrics (replica_failovers_total, "
+        "hedges_total, drains_total, per_replica) "
+        "(docs/SERVING.md 'Replicated serving')",
+    )
+    sp.add_argument(
+        "--hedge-ms", type=float, default=None, metavar="X",
+        help="with --replicas > 1: duplicate a request onto a second "
+        "replica once it has waited X ms (tail-latency hedging, "
+        "first-committed-wins; the loser cancels and its tokens count "
+        "as hedge_wasted_tokens_total)",
     )
     sp.set_defaults(fn=cmd_serve)
 

@@ -7,8 +7,9 @@ To *prove* the serve engine has the same property, failures must be
 reproducible — a chaos test that cannot replay its faults cannot bisect
 a regression. This module is the seeded, schedulable fault source the
 engine's hook points (``serve.prefill``, ``serve.decode``,
-``serve.device_get``) fire into (docs/OBSERVABILITY.md "Fault
-injection"):
+``serve.device_get``, the periodic-checkpoint ``serve.snapshot``) and
+the supervisor's ``serve.health`` probe fire into
+(docs/OBSERVABILITY.md "Fault injection"):
 
 - **Zero overhead when disabled.** The engine holds ``faults=None`` by
   default and every hook is a single ``is not None`` check on the host
@@ -61,8 +62,17 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 
-#: engine hook points a fault can target
-SITES = ("serve.prefill", "serve.decode", "serve.device_get")
+#: engine + control-plane hook points a fault can target.
+#: ``serve.snapshot`` fires inside the engine's periodic checkpoint —
+#: a fault there models a checkpoint that fails MID-WRITE, so the
+#: engine must keep the previous complete snapshot (a torn checkpoint
+#: is not restorable). ``serve.health`` fires in the supervisor's
+#: per-replica probe — a fault there is a failed health check and
+#: quarantines + fails over the replica (serve/supervisor.py).
+SITES = (
+    "serve.prefill", "serve.decode", "serve.device_get",
+    "serve.snapshot", "serve.health",
+)
 #: fault kinds fire() raises/sleeps for, in rate-table draw order
 FIRE_KINDS = ("transient", "oom", "stall", "kill")
 KINDS = FIRE_KINDS + ("poison",)
@@ -141,6 +151,11 @@ class Fault:
     tick: int | None = None
     request: int | None = None
     slot: int | None = None
+    #: pin the fault to ONE replica of a ReplicaSet (the supervisor
+    #: tags every engine hook firing with its replica index) — the
+    #: replica-targeted ``kill`` the failover drill injects; None
+    #: matches any replica AND single-engine (untagged) firings
+    replica: int | None = None
     times: int = 1
     value: int = POISON_TOKEN
 
@@ -175,6 +190,7 @@ class FaultInjector:
 
     def __init__(self, schedule=(), *, seed: int | None = None,
                  rates: dict[str, float] | None = None,
+                 site_rates: dict[str, dict[str, float]] | None = None,
                  stall_s: float = 0.001, listener=None):
         self.schedule: list[Fault] = list(schedule)
         self.rates = dict(rates or {})
@@ -189,7 +205,30 @@ class FaultInjector:
                     f"fault rate for {kind!r} must be in [0, 1], got "
                     f"{rate}"
                 )
-        if self.rates and seed is None:
+        #: per-site rate OVERRIDES layered on the global table — how a
+        #: drill raises pressure on one hook (say the snapshot path)
+        #: without also chaos-ing every dispatch
+        self.site_rates = {
+            site: dict(kinds) for site, kinds in (site_rates or {}).items()
+        }
+        for site, kinds in self.site_rates.items():
+            if site not in SITES:
+                raise FriendlyError(
+                    f"unknown fault site {site!r} in site_rates; hook "
+                    f"points are {SITES}"
+                )
+            for kind, rate in kinds.items():
+                if kind not in KINDS:
+                    raise FriendlyError(
+                        f"unknown fault kind {kind!r} in site_rates"
+                        f"[{site!r}]; kinds are {KINDS}"
+                    )
+                if not 0.0 <= float(rate) <= 1.0:
+                    raise FriendlyError(
+                        f"fault rate for {site}:{kind} must be in "
+                        f"[0, 1], got {rate}"
+                    )
+        if (self.rates or self.site_rates) and seed is None:
             raise FriendlyError(
                 "rate-based fault injection needs a seed — unseeded "
                 "faults cannot be replayed, which defeats the harness"
@@ -210,7 +249,8 @@ class FaultInjector:
             self.listener(kind, site)
 
     def _take(self, site: str, kinds: tuple, *, tick: int,
-              request: int | None, slot: int | None = None) -> Fault | None:
+              request: int | None, slot: int | None = None,
+              replica: int | None = None) -> Fault | None:
         """Pop (decrement) the first matching unspent schedule entry."""
         for f in self.schedule:
             if f.times <= 0 or f.site != site or f.kind not in kinds:
@@ -227,15 +267,28 @@ class FaultInjector:
                 continue
             if f.slot is not None and slot is not None and f.slot != slot:
                 continue
+            # replica targeting: a pinned fault fires ONLY on that
+            # replica's tagged hooks — an untagged (single-engine)
+            # firing never matches a replica-pinned entry
+            if f.replica is not None and f.replica != replica:
+                continue
             f.times -= 1
             return f
         return None
 
-    def _draw(self, kinds: tuple) -> str | None:
+    def _rate(self, site: str, kind: str) -> float:
+        """Effective rate for one (site, kind): the site override when
+        present, else the global table."""
+        over = self.site_rates.get(site)
+        if over is not None and kind in over:
+            return float(over[kind])
+        return float(self.rates.get(kind, 0.0))
+
+    def _draw(self, site: str, kinds: tuple) -> str | None:
         """One seeded uniform against the cumulative rate table."""
         if self._rng is None:
             return None
-        active = [(k, self.rates.get(k, 0.0)) for k in kinds]
+        active = [(k, self._rate(site, k)) for k in kinds]
         if not any(r for _, r in active):
             return None
         u = float(self._rng.random())
@@ -248,14 +301,17 @@ class FaultInjector:
 
     # -- the engine-facing surface -----------------------------------------
 
-    def fire(self, site: str, *, tick: int,
-             request: int | None = None) -> None:
+    def fire(self, site: str, *, tick: int, request: int | None = None,
+             replica: int | None = None) -> None:
         """One hook firing: raise/stall per the schedule and rate
         table, or return silently. Called by the engine immediately
         BEFORE the guarded dispatch, so a raised fault never consumes
-        donated buffers."""
-        f = self._take(site, FIRE_KINDS, tick=tick, request=request)
-        kind = f.kind if f is not None else self._draw(FIRE_KINDS)
+        donated buffers. ``replica`` is the firing engine's ReplicaSet
+        index (None outside a supervisor) — what replica-pinned
+        schedule entries match against."""
+        f = self._take(site, FIRE_KINDS, tick=tick, request=request,
+                       replica=replica)
+        kind = f.kind if f is not None else self._draw(site, FIRE_KINDS)
         if kind is None:
             return
         self._record(kind, site)
@@ -273,20 +329,23 @@ class FaultInjector:
         time.sleep(self.stall_s)
 
     def poison_value(self, site: str, *, tick: int,
-                     request: int | None = None) -> int | None:
+                     request: int | None = None,
+                     replica: int | None = None) -> int | None:
         """Poison token for one request's scalar token (the prefill
         first-token path), or None."""
-        f = self._take(site, ("poison",), tick=tick, request=request)
+        f = self._take(site, ("poison",), tick=tick, request=request,
+                       replica=replica)
         if f is not None:
             self._record("poison", site)
             return int(f.value)
-        if self._draw(("poison",)) is not None:
+        if self._draw(site, ("poison",)) is not None:
             self._record("poison", site)
             return POISON_TOKEN
         return None
 
     def poison_block(self, site: str, tokens: np.ndarray, *, tick: int,
-                     slots: list[int]) -> np.ndarray:
+                     slots: list[int],
+                     replica: int | None = None) -> np.ndarray:
         """Poison the fetched ``(S, T)`` decode block: corrupt column 0
         of a targeted (or the lowest, or a seeded-drawn) active slot's
         row. Returns a fresh array; the device state is untouched —
@@ -297,12 +356,12 @@ class FaultInjector:
         hit: list[tuple[int, int]] = []
         for slot in slots:
             f = self._take(site, ("poison",), tick=tick, request=None,
-                           slot=slot)
+                           slot=slot, replica=replica)
             if f is not None:
                 self._record("poison", site)
                 hit.append((slot if f.slot is None else f.slot, f.value))
                 continue
-            if self._draw(("poison",)) is not None:
+            if self._draw(site, ("poison",)) is not None:
                 self._record("poison", site)
                 hit.append((slot, POISON_TOKEN))
         if not hit:
@@ -316,10 +375,15 @@ class FaultInjector:
 def parse_fault_spec(spec: str) -> FaultInjector:
     """CLI/bench spelling -> injector: ``"seed=7,transient=0.05,
     oom=0.02,poison=0.02,stall=0.01,stall_s=0.001"``. Kind keys are
-    rates; ``seed`` and ``stall_s`` configure the injector."""
+    rates; ``seed`` and ``stall_s`` configure the injector. A key of
+    the form ``site:kind`` (``"serve.snapshot:transient=0.5"``) scopes
+    the rate to ONE hook site — how a CLI drill pressures the
+    checkpoint or health-probe paths without chaos-ing every
+    dispatch."""
     seed = None
     stall_s = 0.001
     rates: dict[str, float] = {}
+    site_rates: dict[str, dict[str, float]] = {}
     for part in spec.split(","):
         part = part.strip()
         if not part:
@@ -336,15 +400,31 @@ def parse_fault_spec(spec: str) -> FaultInjector:
                 seed = int(value)
             elif key == "stall_s":
                 stall_s = float(value)
+            elif ":" in key:
+                site, _, kind = key.partition(":")
+                site, kind = site.strip(), kind.strip()
+                if site not in SITES:
+                    raise FriendlyError(
+                        f"unknown fault site {site!r} in spec key "
+                        f"{key!r}; hook points are {SITES}"
+                    )
+                if kind not in KINDS:
+                    raise FriendlyError(
+                        f"unknown fault kind {kind!r} in spec key "
+                        f"{key!r}; kinds are {KINDS}"
+                    )
+                site_rates.setdefault(site, {})[kind] = float(value)
             elif key in KINDS:
                 rates[key] = float(value)
             else:
                 raise FriendlyError(
                     f"unknown fault spec key {key!r}; use 'seed', "
-                    f"'stall_s', or a kind rate from {KINDS}"
+                    f"'stall_s', a kind rate from {KINDS}, or a "
+                    "site-scoped 'site:kind' rate"
                 )
         except ValueError as e:
             raise FriendlyError(
                 f"bad fault spec value {value!r} for {key!r}: {e}"
             ) from e
-    return FaultInjector(seed=seed, rates=rates, stall_s=stall_s)
+    return FaultInjector(seed=seed, rates=rates, site_rates=site_rates,
+                         stall_s=stall_s)

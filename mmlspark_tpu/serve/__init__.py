@@ -16,6 +16,11 @@ status ``"failed"`` instead of killing ``run()``, and
 ``ServeEngine.snapshot()``/``restore()`` checkpoint host-side request
 state for crash recovery. :class:`~mmlspark_tpu.core.faults.FaultInjector`
 (re-exported here) is the deterministic harness that proves all of it.
+
+For replicated serving, :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet`
+(docs/SERVING.md "Replicated serving") puts N engines behind one
+``submit()/run()`` facade with health probes, snapshot-based failover,
+hedged routing, and zero-loss drain.
 """
 
 from mmlspark_tpu.core.faults import (  # noqa: F401
@@ -38,3 +43,4 @@ from mmlspark_tpu.serve.scheduler import (  # noqa: F401
     RequestResult,
     ServeRequest,
 )
+from mmlspark_tpu.serve.supervisor import ReplicaSet  # noqa: F401

@@ -160,6 +160,11 @@ class SlotCachePool:
     def leased_count(self) -> int:
         return len(self._leased)
 
+    def leased_slots(self) -> list[int]:
+        """Leased slot ids, ascending — what the engine's kill-parking
+        walks to return every held slot deterministically."""
+        return sorted(self._leased)
+
     @property
     def utilization(self) -> float:
         return len(self._leased) / self.num_slots

@@ -37,9 +37,15 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              trace_out: str | None = None,
              paged: bool = False,
              page_size: int | None = None,
-             prefix_cache: bool = False) -> dict:
+             prefix_cache: bool = False,
+             replicas: int = 1,
+             hedge_ms: float | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
-    prints as its one JSON line."""
+    prints as its one JSON line. With ``replicas > 1`` the loop drives
+    a :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet` instead of a
+    single engine (docs/SERVING.md "Replicated serving") and the JSON
+    line is the supervisor's ``metrics_dict`` — control-plane totals
+    plus one nested dict per replica."""
     import jax
     import jax.numpy as jnp
 
@@ -54,16 +60,12 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
     variables = graph.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
     )
-    engine = ServeEngine(
-        graph, variables, slots=slots, cache_len=cache_len,
+    engine_kwargs = dict(
+        slots=slots, cache_len=cache_len,
         max_queue=max(n_requests, 1),
         # "data=4,model=2"-style mesh spec -> the sharded engine
         # (docs/SERVING.md "Sharded serving"); None = single device
         mesh=mesh or None,
-        # "seed=7,transient=0.05,oom=0.02"-style fault spec -> seeded
-        # chaos injection (docs/OBSERVABILITY.md "Fault injection");
-        # None = no injector, hooks cost one attribute check
-        faults=parse_fault_spec(faults) if faults else None,
         # "ttft_p99_ms=50,error_rate=0.05"-style SLO spec -> rolling-
         # window monitor + load shedding (docs/OBSERVABILITY.md
         # "Declaring SLOs"); None = undeclared
@@ -75,6 +77,20 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
     )
+    # "seed=7,transient=0.05,oom=0.02"-style fault spec -> seeded
+    # chaos injection (docs/OBSERVABILITY.md "Fault injection");
+    # None = no injector, hooks cost one attribute check
+    injector = parse_fault_spec(faults) if faults else None
+    if replicas > 1:
+        from mmlspark_tpu.serve.supervisor import ReplicaSet
+
+        target = ReplicaSet(
+            graph, variables, replicas=replicas, hedge_ms=hedge_ms,
+            faults=injector, **engine_kwargs,
+        )
+    else:
+        target = ServeEngine(graph, variables, faults=injector,
+                             **engine_kwargs)
 
     rng = np.random.default_rng(seed)
     lo, hi = 4, max(5, min(16, cache_len - max_new_tokens))
@@ -83,26 +99,35 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
 
     submitted = 0
     results = {}
-    while submitted < n_requests or engine.busy:
+    while submitted < n_requests or target.busy:
         for _ in range(arrivals_per_tick):
             if submitted < n_requests:
-                engine.submit(
+                target.submit(
                     prompts[submitted], max_new_tokens,
                     deadline_ticks=deadline_ticks,
                 )
                 submitted += 1
-        for res in engine.step():
+        for res in target.step():
             results[res.id] = res
 
-    out = engine.metrics.to_dict()
+    if replicas > 1:
+        out = target.metrics_dict()
+        recorder = target.recorder
+        registry = target.registry
+    else:
+        out = target.metrics.to_dict()
+        out.update(
+            decode_compiles=target.decode_compile_count,
+            prefill_compiles=target.prefill_compile_count,
+            prefill_bucket_count=target.num_prefill_buckets,
+        )
+        recorder = target.recorder
+        registry = target.metrics.registry
     out.update(
         n_requests=n_requests,
         arrivals_per_tick=arrivals_per_tick,
         max_new_tokens=max_new_tokens,
         cache_len=cache_len,
-        decode_compiles=engine.decode_compile_count,
-        prefill_compiles=engine.prefill_compile_count,
-        prefill_bucket_count=engine.num_prefill_buckets,
         model_config={"vocab": vocab, "d_model": d_model, "heads": heads,
                       "depth": depth},
     )
@@ -110,23 +135,28 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         from mmlspark_tpu.core.perf import export_chrome_trace
 
         os.makedirs(telemetry_dir, exist_ok=True)
-        engine.recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
+        # replica mode dumps the SUPERVISOR's recorder/registry (the
+        # control-plane timeline: routed/failover/hedge/drain events);
+        # each engine keeps its own recorder and registry — their
+        # perf.*/slo.* names are un-namespaced, so concatenating the
+        # engine expositions would collide
+        recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
         with open(os.path.join(telemetry_dir, "metrics.json"), "w",
                   encoding="utf-8") as f:
             json.dump(out, f, indent=1, default=str)
         # the full telemetry bundle: the Perfetto-loadable trace and
         # the Prometheus text exposition land next to events/metrics
         export_chrome_trace(
-            engine.recorder,
+            recorder,
             path=os.path.join(telemetry_dir, "trace.json"),
             extra_meta={"model": graph.name},
         )
         with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
                   encoding="utf-8") as f:
-            f.write(engine.metrics.registry.to_prometheus())
+            f.write(registry.to_prometheus())
     if trace_out:
         from mmlspark_tpu.core.perf import export_chrome_trace
 
-        export_chrome_trace(engine.recorder, path=trace_out,
+        export_chrome_trace(recorder, path=trace_out,
                             extra_meta={"model": graph.name})
     return out

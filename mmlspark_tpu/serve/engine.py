@@ -52,6 +52,7 @@ import jax.numpy as jnp
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.faults import (
+    EngineKilled,
     FaultInjector,
     is_resource_exhausted,
     is_transient,
@@ -118,7 +119,9 @@ class ServeEngine:
                  slo=None,
                  paged: bool = False, page_size: int | None = None,
                  num_pages: int | None = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 replica: int | None = None,
+                 snapshot_every_ticks: int | None = None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -201,6 +204,29 @@ class ServeEngine:
         else:
             self.pool = SlotCachePool(graph, variables, slots, cache_len,
                                       mesh=self.mesh)
+        # replica identity (serve/supervisor.py): tags every fault-hook
+        # firing (so replica-pinned kills target THIS engine) and
+        # namespaces the registry metric names per replica
+        if replica is not None and replica < 0:
+            raise FriendlyError(
+                f"replica index must be >= 0, got {replica}"
+            )
+        self._replica = replica
+        # periodic snapshot cadence: every N ticks, step() refreshes
+        # ``last_snapshot`` through the serve.snapshot fault hook — the
+        # supervisor's recovery point. None (the default) keeps
+        # snapshotting fully caller-driven, zero work per tick.
+        if snapshot_every_ticks is not None and snapshot_every_ticks < 1:
+            raise FriendlyError(
+                f"snapshot_every_ticks must be >= 1, got "
+                f"{snapshot_every_ticks}"
+            )
+        self._snapshot_every = snapshot_every_ticks
+        self._last_snapshot: dict | None = None
+        #: set when an EngineKilled escaped and the device resources
+        #: were parked — the engine refuses further steps (restore
+        #: from a snapshot instead)
+        self._dead = False
         self.metrics = ServeMetrics(
             graph.name, slots, decode_block=self.decode_block,
             mesh_shape=(
@@ -212,6 +238,9 @@ class ServeEngine:
             ),
             cache_pool_bytes_per_device=(
                 self.pool.device_bytes_per_device()
+            ),
+            namespace=(
+                f"replica{replica}." if replica is not None else ""
             ),
         )
         if paged:
@@ -669,7 +698,29 @@ class ServeEngine:
         finished sequences. Admission and retirement happen at block
         boundaries; the single host sync per tick fetches the whole
         ``(S, T)`` token block plus the finished vector. Returns the
-        requests that reached a terminal state this tick."""
+        requests that reached a terminal state this tick.
+
+        An :class:`EngineKilled` escaping the tick (the simulated
+        process crash) first PARKS the device resources
+        deterministically — every leased slot returns to the pool, a
+        paged pool's page mappings release — so a supervisor that
+        restores this engine's snapshot in the same process never
+        double-holds pages; the dead engine then refuses further
+        steps."""
+        if self._dead:
+            raise FriendlyError(
+                "this engine was killed (EngineKilled) and its device "
+                "resources parked; rebuild it with "
+                "ServeEngine.restore(snapshot, ...) instead of "
+                "stepping it again"
+            )
+        try:
+            return self._step_inner()
+        except EngineKilled:
+            self._park_after_kill()
+            raise
+
+    def _step_inner(self) -> list[RequestResult]:
         t0 = time.perf_counter()
         tick = self._sched.tick_count
         finished = self._sched.expire(tick)
@@ -755,6 +806,7 @@ class ServeEngine:
                                     self._faults.fire(
                                         "serve.prefill", tick=tick,
                                         request=req.id,
+                                        replica=self._replica,
                                     )
                                 first_d, cache = self._resume(
                                     self.variables,
@@ -823,6 +875,7 @@ class ServeEngine:
                                     self._faults.fire(
                                         "serve.prefill", tick=tick,
                                         request=req.id,
+                                        replica=self._replica,
                                     )
                                 first_d, cache = self._prefill(
                                     self.variables,
@@ -855,7 +908,8 @@ class ServeEngine:
                     continue
                 if self._faults is not None:
                     poison = self._faults.poison_value(
-                        "serve.prefill", tick=tick, request=req.id
+                        "serve.prefill", tick=tick, request=req.id,
+                        replica=self._replica,
                     )
                     if poison is not None:
                         first = int(poison)
@@ -916,6 +970,15 @@ class ServeEngine:
         # tick's admission sees the freshest shed signal
         if self._slo is not None:
             self._slo.evaluate(tick=tick)
+        # periodic snapshot cadence (docs/SERVING.md "Replicated
+        # serving"): refresh the recovery point every N completed ticks
+        # — a shorter cadence re-decodes less after failover, a longer
+        # one checkpoints less often
+        if (
+            self._snapshot_every is not None
+            and self._sched.tick_count % self._snapshot_every == 0
+        ):
+            self.checkpoint()
         return finished
 
     def _decode_phase(self, tick: int, finished: list) -> int:
@@ -985,7 +1048,8 @@ class ServeEngine:
                     # buffers, so retrying with the same pool state is
                     # always safe
                     if self._faults is not None:
-                        self._faults.fire("serve.decode", tick=tick)
+                        self._faults.fire("serve.decode", tick=tick,
+                                          replica=self._replica)
                     toks, live, buffers, positions = self._decode(
                         self.variables, self.pool.buffers,
                         self.pool.positions, self.pool.live,
@@ -1023,7 +1087,8 @@ class ServeEngine:
             while True:
                 try:
                     if self._faults is not None:
-                        self._faults.fire("serve.device_get", tick=tick)
+                        self._faults.fire("serve.device_get", tick=tick,
+                                          replica=self._replica)
                     # the ONE host sync per block: (S, T) tokens + the
                     # per-slot finished vector come back together
                     toks_h, live_h = jax.device_get((toks, live))
@@ -1055,6 +1120,7 @@ class ServeEngine:
                     "serve.device_get", toks_h, tick=tick,
                     slots=[s for s, _ in states
                            if s in self._sched.active],
+                    replica=self._replica,
                 )
             # token-stream validation (always on — one vectorized pass
             # over an (S, T) int block): greedy tokens are argmax
@@ -1162,7 +1228,195 @@ class ServeEngine:
                     results[res.id] = res
         return results
 
+    # -- replica control plane (serve/supervisor.py drives these) ----------
+
+    @property
+    def queue_full(self) -> bool:
+        """True when the next ``submit`` would bounce off admission
+        control — the supervisor's router checks this before choosing a
+        replica."""
+        return self._sched.queue_depth >= self._sched.max_queue
+
+    def cancel(self, request_id: int) -> int | None:
+        """Cancel one pending request WITHOUT a terminal result: the
+        hedge loser's exit (first-committed-wins — the winning replica
+        already committed the stream, this copy's tokens are waste) and
+        failover dedup. Queued entries leave the queue; active ones
+        free their slot. Returns the emitted-token count discarded, or
+        None when the id is unknown/terminal (or the engine is dead —
+        its resources are already parked)."""
+        if self._dead:
+            return None
+        emitted = self._sched.cancel(request_id)
+        if emitted is None:
+            return None
+        self.metrics.record_cancel()
+        span = self._spans.pop(request_id, None)
+        if span is not None:
+            span.end("cancelled", tick=self.tick)
+        self.recorder.record(
+            "cancelled", tick=self.tick, id=request_id, emitted=emitted,
+        )
+        return emitted
+
+    def steal_all(self) -> list[dict]:
+        """Hand off EVERY pending request for migration to another
+        replica (zero-loss drain, or stall cleanup): active slots
+        preempt — their emitted tokens fold into resume prefixes and
+        their slots free — then the queue drains in FIFO order.
+        Returns plain payload dicts for :meth:`adopt` on the target
+        engine; re-prefilling prompt + prefix there continues each
+        stream bit-identically (greedy determinism)."""
+        reqs = self._sched.handoff_all() if not self._dead else []
+        out = []
+        for req in reqs:
+            out.append({
+                "id": req.id,
+                "prompt": np.asarray(req.prompt, np.int32),
+                "prefix": np.asarray(req.prefix, np.int32),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+            })
+            span = self._spans.pop(req.id, None)
+            if span is not None:
+                span.end("migrated", tick=self.tick,
+                         prefix_len=len(req.prefix))
+        if out:
+            self.recorder.record("handoff", tick=self.tick, n=len(out))
+        return out
+
+    def adopt(self, prompt, *, prefix=(), max_new_tokens: int,
+              eos_id: int | None = None) -> int:
+        """Admit a request MIGRATED from another replica (drain
+        hand-off or failover re-route): ``prefix`` is the tokens the
+        source replica already emitted, re-prefilled with the prompt so
+        decode resumes exactly where it stopped and accepted tokens are
+        never re-emitted. Bypasses ``max_queue`` — the request was
+        admitted once already; bouncing it now would turn migration
+        into data loss. Returns the new engine-local id."""
+        prompt = np.asarray(prompt, np.int32)
+        prefix = np.asarray(prefix, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise FriendlyError(
+                f"adopt needs a non-empty 1-D prompt, got shape "
+                f"{prompt.shape}"
+            )
+        if len(prefix) >= max_new_tokens:
+            raise FriendlyError(
+                f"adopted prefix ({len(prefix)} tokens) already meets "
+                f"the request budget ({max_new_tokens}); the source "
+                "replica should have retired it as completed"
+            )
+        if int(prompt.size) + max_new_tokens > self.cache_len:
+            raise FriendlyError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds this engine's cache_len "
+                f"({self.cache_len}); migrate to a replica with equal "
+                "cache geometry"
+            )
+        req = ServeRequest(
+            id=self._next_id,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_id=eos_id,
+            deadline_tick=None,
+            submit_tick=self.tick,
+            submit_wall=time.perf_counter(),
+            prefix=prefix,
+        )
+        self._sched.queue.append(req)
+        self._next_id += 1
+        self.metrics.record_submit()
+        span = self._tracer.span(
+            "request", tick=self.tick, id=req.id,
+            prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
+        )
+        span.event("adopted", tick=self.tick, prefix_len=len(prefix))
+        self._spans[req.id] = span
+        return req.id
+
+    def health_counters(self) -> dict:
+        """The supervisor's probe surface: liveness/readiness inputs in
+        one cheap host-side dict (no device sync) — tick progress,
+        queue/slot load, degradation, SLO burn, and the fault/retry
+        totals the health model scores."""
+        return {
+            "tick": self.tick,
+            "busy": self.busy,
+            "dead": self._dead,
+            "queue_depth": self.queue_depth,
+            "active": len(self._sched.active),
+            "degraded": self.degraded,
+            "slo_burning": (
+                bool(self._slo.should_shed)
+                if self._slo is not None else False
+            ),
+            "retries_total": self.metrics.retries_total,
+            "quarantined_total": self.metrics.quarantined_total,
+            "faults_injected_total": self.metrics.faults_injected_total,
+            "tokens_generated": self.metrics.tokens_generated,
+        }
+
+    def _park_after_kill(self) -> None:
+        """Deterministic device-resource parking for a killed engine:
+        every leased slot frees back to the pool — on a paged pool that
+        releases the slot's page mappings (refcounts drop; pages return
+        to the free lists, or survive only under prefix-cache
+        references) — so an in-process supervisor restoring this
+        engine's snapshot onto a fresh engine never double-holds
+        device state. Host request bookkeeping is kept for post-mortem
+        snapshots; the engine refuses further steps."""
+        if self._dead:
+            return
+        self._dead = True
+        leased = self.pool.leased_slots()
+        for slot in leased:
+            self.pool.free(slot)
+        self.recorder.record(
+            "killed", tick=self.tick, parked_slots=len(leased),
+        )
+
     # -- checkpoint / restore ----------------------------------------------
+
+    @property
+    def last_snapshot(self) -> dict | None:
+        """The most recent COMPLETE periodic checkpoint (see
+        ``snapshot_every_ticks`` / :meth:`checkpoint`) — the
+        supervisor's recovery point. A checkpoint that failed mid-write
+        never lands here."""
+        return self._last_snapshot
+
+    def checkpoint(self) -> dict | None:
+        """Take one periodic checkpoint through the ``serve.snapshot``
+        fault hook. A fault here models a checkpoint failing MID-WRITE:
+        the torn snapshot is NOT restorable, so ``last_snapshot`` keeps
+        the previous complete one and serving continues (the failure is
+        counted + recorded). Returns the new snapshot dict, or None
+        when the write failed. An injected ``kill`` at the snapshot
+        site is a crash during checkpointing — it parks and re-raises
+        like any other kill."""
+        try:
+            if self._faults is not None:
+                self._faults.fire("serve.snapshot", tick=self.tick,
+                                  replica=self._replica)
+            snap = self.snapshot()
+        except EngineKilled:
+            self._park_after_kill()
+            raise
+        except Exception as e:  # noqa: BLE001 — a torn checkpoint must
+            # not take serving down; the engine keeps the previous one
+            self.metrics.record_snapshot_failure()
+            self.recorder.record(
+                "snapshot_failed", tick=self.tick, error=str(e),
+            )
+            return None
+        self._last_snapshot = snap
+        self.metrics.record_snapshot()
+        self.recorder.record(
+            "snapshot", tick=self.tick,
+            active=len(snap["active"]), queued=len(snap["queued"]),
+        )
+        return snap
 
     def snapshot(self) -> dict:
         """JSON-able checkpoint of ALL host-side request state: every
@@ -1269,4 +1523,8 @@ class ServeEngine:
             span.event("restored", tick=engine.tick,
                        prefix_len=len(req.prefix))
             engine._spans[req.id] = span
+        # the restored engine's initial recovery point IS the snapshot
+        # it was built from — a kill before the first periodic refresh
+        # still has a complete checkpoint to fail over to
+        engine._last_snapshot = snapshot
         return engine

@@ -45,9 +45,17 @@ class ServeMetrics:
                  decode_block: int = 1,
                  mesh_shape: dict[str, int] | None = None,
                  mesh_devices: int = 1,
-                 cache_pool_bytes_per_device: int = 0):
+                 cache_pool_bytes_per_device: int = 0,
+                 namespace: str = ""):
         self.model = model
         self.slots = slots
+        #: per-replica metric namespacing (serve/supervisor.py): a
+        #: non-empty namespace ("replica0.") prefixes every registry
+        #: metric name, so N replicas' registries concatenate into ONE
+        #: Prometheus exposition without name collisions; the flat
+        #: ``to_dict`` keys stay unprefixed (consumers see one schema,
+        #: the supervisor nests per-replica dicts instead)
+        self.namespace = namespace
         #: the engine's configured max fused-block size (T); surfaced in
         #: to_dict so dashboards can normalize block-aware figures
         self.decode_block = decode_block
@@ -61,20 +69,29 @@ class ServeMetrics:
         self.cache_pool_bytes_per_device = cache_pool_bytes_per_device
         self.registry = registry if registry is not None else MetricRegistry()
         r = self.registry
-        self._submitted = r.counter("serve.submitted")
-        self._rejected = r.counter("serve.rejected")
-        self._completed = r.counter("serve.completed")
-        self._expired = r.counter("serve.expired")
-        self._failed = r.counter("serve.failed")
-        self._stalled = r.counter("serve.stalled")
-        self._tokens_generated = r.counter("serve.tokens_generated")
-        self._prefills = r.counter("serve.prefills")
+
+        def n(name: str) -> str:
+            return f"{namespace}{name}"
+
+        self._submitted = r.counter(n("serve.submitted"))
+        self._rejected = r.counter(n("serve.rejected"))
+        self._completed = r.counter(n("serve.completed"))
+        self._expired = r.counter(n("serve.expired"))
+        self._failed = r.counter(n("serve.failed"))
+        self._stalled = r.counter(n("serve.stalled"))
+        self._tokens_generated = r.counter(n("serve.tokens_generated"))
+        self._prefills = r.counter(n("serve.prefills"))
         # resilience plane (docs/SERVING.md "Failure semantics"):
         # injected faults, retry absorptions, quarantines, preemptions
-        self._retries = r.counter("serve.retries")
-        self._faults_injected = r.counter("serve.faults_injected")
-        self._quarantined = r.counter("serve.quarantined")
-        self._preemptions = r.counter("serve.preemptions")
+        self._retries = r.counter(n("serve.retries"))
+        self._faults_injected = r.counter(n("serve.faults_injected"))
+        self._quarantined = r.counter(n("serve.quarantined"))
+        self._preemptions = r.counter(n("serve.preemptions"))
+        # control plane (docs/SERVING.md "Replicated serving"):
+        # periodic checkpoints taken/failed and hedge-loser cancels
+        self._snapshots = r.counter(n("serve.snapshots"))
+        self._snapshot_failures = r.counter(n("serve.snapshot_failures"))
+        self._cancelled = r.counter(n("serve.cancelled"))
         #: 1 while the engine runs below its configured decode-block
         #: ladder top or admission cap (memory-pressure degradation),
         #: 0 once the recovery probe has re-escalated to full service
@@ -82,9 +99,9 @@ class ServeMetrics:
         #: injected-fault count per kind (mirrors the injector's own
         #: ``counts``; rides to_dict as a table like prefill_buckets)
         self.faults_by_kind: dict[str, int] = {}
-        self._ttft_ms = r.histogram("serve.ttft_ms")
-        self._per_token_ms = r.histogram("serve.per_token_ms")
-        self._tick_ms = r.histogram("serve.tick_ms")
+        self._ttft_ms = r.histogram(n("serve.ttft_ms"))
+        self._per_token_ms = r.histogram(n("serve.per_token_ms"))
+        self._tick_ms = r.histogram(n("serve.tick_ms"))
         self.queue_depth_samples: list[int] = []
         self.util_samples: list[float] = []
         self.tick_seconds: list[float] = []
@@ -116,7 +133,7 @@ class ServeMetrics:
         )
         #: rolling-window SLO monitor (attach_slo); None -> undeclared
         self.slo: SloMonitor | None = None
-        self._slo_shed_ticks = r.counter("serve.slo_shed_ticks")
+        self._slo_shed_ticks = r.counter(n("serve.slo_shed_ticks"))
         #: paged KV-cache stats provider (attach_paging); None -> dense
         #: pool, the paging keys report inert defaults so the flat
         #: schema stays fixed across pool kinds
@@ -210,6 +227,18 @@ class ServeMetrics:
     @property
     def preemptions_total(self) -> int:
         return self._preemptions.value
+
+    @property
+    def snapshots_total(self) -> int:
+        return self._snapshots.value
+
+    @property
+    def snapshot_failures_total(self) -> int:
+        return self._snapshot_failures.value
+
+    @property
+    def cancelled_total(self) -> int:
+        return self._cancelled.value
 
     @property
     def tokens_generated(self) -> int:
@@ -306,6 +335,26 @@ class ServeMetrics:
         """One active request evicted + requeued under memory
         pressure."""
         self._preemptions.inc()
+
+    def record_snapshot(self) -> None:
+        """One periodic checkpoint written completely."""
+        self._snapshots.inc()
+
+    def record_snapshot_failure(self) -> None:
+        """One checkpoint that failed mid-write (NOT restorable — the
+        engine keeps serving from the previous complete snapshot)."""
+        self._snapshot_failures.inc()
+
+    def record_cancel(self) -> None:
+        """One pending request cancelled by the supervisor (a hedge's
+        losing copy, or failover dedup)."""
+        self._cancelled.inc()
+
+    def ttft_p99_ms(self) -> float | None:
+        """The routing signal the supervisor reads per replica (with
+        queue depth): TTFT p99 from the live histogram, no device
+        sync."""
+        return self._ttft_ms.percentile(99)
 
     def set_degraded(self, degraded: bool) -> None:
         self.degraded_mode = int(degraded)
@@ -420,6 +469,13 @@ class ServeMetrics:
             "preemptions_total": self.preemptions_total,
             "degraded_mode": self.degraded_mode,
             "faults_by_kind": dict(self.faults_by_kind),
+            # replica control plane (docs/SERVING.md "Replicated
+            # serving"; schema-gated): periodic-checkpoint activity and
+            # supervisor-initiated cancels — zeros on unsupervised
+            # engines, so the flat schema stays fixed
+            "snapshots_total": self.snapshots_total,
+            "snapshot_failures_total": self.snapshot_failures_total,
+            "cancelled_total": self.cancelled_total,
             # device-level analytics (docs/OBSERVABILITY.md
             # "Device-level performance analytics"; schema-gated):
             # headline utilization, the device-vs-host time split, the

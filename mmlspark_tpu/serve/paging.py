@@ -461,6 +461,12 @@ class PagedCachePool:
     def leased_count(self) -> int:
         return len(self._leased)
 
+    def leased_slots(self) -> list[int]:
+        """Leased slot ids, ascending — what the engine's kill-parking
+        walks to return every held slot (and its page mappings)
+        deterministically."""
+        return sorted(self._leased)
+
     @property
     def utilization(self) -> float:
         return len(self._leased) / self.num_slots

@@ -272,6 +272,37 @@ class ContinuousBatchScheduler:
         would turn backpressure into data loss."""
         self.queue.appendleft(req)
 
+    # -- replica hand-off (serve/supervisor.py calls these) ----------------
+
+    def cancel(self, request_id: int) -> int | None:
+        """Remove one pending request WITHOUT a terminal result: a
+        queued entry leaves the queue, an active one frees its slot
+        (device live mask forced dead, like quarantine). Returns the
+        count of tokens already emitted for it (what a hedge's losing
+        copy wastes — first-committed-wins accounting), or None when
+        the id is unknown or already terminal."""
+        for req in self.queue:
+            if req.id == request_id:
+                self.queue.remove(req)
+                return len(req.prefix)
+        for slot, st in list(self.active.items()):
+            if st.req.id == request_id:
+                del self.active[slot]
+                self.pool.free(slot)
+                return len(st.out)
+        return None
+
+    def handoff_all(self) -> list[ServeRequest]:
+        """Pop EVERY pending request for migration to another replica:
+        active slots preempt first (slots free, emitted tokens folded
+        into resume prefixes — re-prefilling prompt + prefix elsewhere
+        continues each stream bit-identically), then the queue in FIFO
+        order. Zero-loss drain's request hand-off."""
+        out = [self.preempt(slot) for slot in sorted(self.active)]
+        while self.queue:
+            out.append(self.queue.popleft())
+        return out
+
     def stall_pending(self, tick: int) -> list[RequestResult]:
         """Retire EVERY still-pending request (queued and active) with
         the definite terminal status ``"stalled"`` — ``run()``'s

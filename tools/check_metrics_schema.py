@@ -20,6 +20,13 @@ Three surfaces, all produced by ONE subprocess run at smoke scale:
 - ``metrics.prom``: the Prometheus text exposition with real
   histogram ``_bucket`` series.
 
+A second run at ``--replicas 2`` pins the replicated-serving contract
+(docs/SERVING.md "Replicated serving"): the JSON line becomes
+``ReplicaSet.metrics_dict()`` — control-plane totals plus one
+``per_replica.replica{i}`` nested dict — and the telemetry bundle is
+the supervisor's recorder/registry (failover/hedge/drain counters in
+the exposition, ``routed`` events in the timeline).
+
 Exits non-zero with a pointed message on the first violation, so
 ``tools/ci.sh`` catches schema drift before a dashboard does
 (docs/OBSERVABILITY.md). Usage::
@@ -97,6 +104,12 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "preemptions_total": (int,),
     "degraded_mode": (int,),
     "faults_by_kind": (dict,),
+    # replica control plane (docs/SERVING.md "Replicated serving"):
+    # checkpoint/cancel accounting — 0 on an unsupervised run, so
+    # dashboards can alert on snapshot failures without existence checks
+    "snapshots_total": (int,),
+    "snapshot_failures_total": (int,),
+    "cancelled_total": (int,),
     # device-level performance analytics (docs/OBSERVABILITY.md
     # "Device-level performance analytics"): the demo run's backend has
     # a working XLA cost model, so the utilization figures must be real
@@ -131,6 +144,48 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "decode_compiles": (int,),
     "prefill_compiles": (int,),
     "prefill_bucket_count": (int,),
+}
+
+# the --replicas JSON line is ReplicaSet.metrics_dict() (docs/SERVING.md
+# "Replicated serving"): control-plane totals + one nested dict per
+# replica — a different schema from the single-engine line above
+REQUIRED_REPLICA_KEYS: dict[str, tuple] = {
+    "replicas": (int,),
+    "hedge_ms": NUM + (type(None),),
+    "supervisor_ticks": (int,),
+    "submitted": (int,),
+    "completed": (int,),
+    "failed": (int,),
+    "expired": (int,),
+    "stalled": (int,),
+    "tokens_generated": (int,),
+    "tokens_per_sec": NUM,
+    "wall_s": NUM,
+    "replica_failovers_total": (int,),
+    "hedges_total": (int,),
+    "hedge_wasted_tokens_total": (int,),
+    "drains_total": (int,),
+    "per_replica": (dict,),
+}
+
+REQUIRED_PER_REPLICA_KEYS: dict[str, tuple] = {
+    "state": (str,),
+    "failovers": (int,),
+    "ticks": (int,),
+    "submitted": (int,),
+    "completed": (int,),
+    "failed": (int,),
+    "expired": (int,),
+    "tokens_generated": (int,),
+    "retries_total": (int,),
+    "quarantined_total": (int,),
+    "snapshots_total": (int,),
+    "snapshot_failures_total": (int,),
+    "cancelled_total": (int,),
+    "degraded_mode": (int,),
+    "queue_depth": (int,),
+    "decode_compile_count": (int,),
+    "prefill_compile_count": (int,),
 }
 
 #: engine-emitted event names the trace exporter keys on — renaming
@@ -267,6 +322,95 @@ def check_trace(path: str, n_requests: int) -> int:
     return len(events)
 
 
+def check_replica_mode(env: dict, repo: str) -> None:
+    """Second smoke run with ``--replicas 2``: the JSON line switches to
+    ``ReplicaSet.metrics_dict()`` and the telemetry bundle to the
+    SUPERVISOR's recorder/registry (docs/OBSERVABILITY.md "Replicated
+    serving metrics") — pin both shapes."""
+    with tempfile.TemporaryDirectory() as tdir:
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4",
+            "serve", "--demo", "--slots", "2",
+            "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
+            "--replicas", "2", "--hedge-ms", "50",
+            "--telemetry-dir", tdir,
+        ]
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env=env, cwd=repo,
+        )
+        if res.returncode != 0:
+            fail(f"serve --demo --replicas 2 exited {res.returncode}:\n"
+                 f"{res.stderr}")
+        out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+        if len(out_lines) != 1:
+            fail(
+                f"--replicas stdout must be exactly ONE JSON line, got "
+                f"{len(out_lines)}:\n{res.stdout}"
+            )
+        try:
+            md = json.loads(out_lines[0])
+        except json.JSONDecodeError as e:
+            fail(f"--replicas stdout line is not JSON: {e}")
+        for key, types in REQUIRED_REPLICA_KEYS.items():
+            if key not in md:
+                fail(f"--replicas stdout: missing key {key!r}")
+            if not isinstance(md[key], types):
+                fail(
+                    f"--replicas stdout: key {key!r} has type "
+                    f"{type(md[key]).__name__}, expected one of "
+                    f"{[t.__name__ for t in types]} (value: {md[key]!r})"
+                )
+        if md["replicas"] != 2:
+            fail(f"--replicas 2 must report replicas == 2, got "
+                 f"{md['replicas']!r}")
+        if set(md["per_replica"]) != {"replica0", "replica1"}:
+            fail(f"per_replica must hold replica0/replica1, got "
+                 f"{sorted(md['per_replica'])}")
+        for rname, sub in md["per_replica"].items():
+            for key, types in REQUIRED_PER_REPLICA_KEYS.items():
+                if key not in sub:
+                    fail(f"per_replica.{rname}: missing key {key!r}")
+                if not isinstance(sub[key], types):
+                    fail(
+                        f"per_replica.{rname}: key {key!r} has type "
+                        f"{type(sub[key]).__name__}, expected one of "
+                        f"{[t.__name__ for t in types]}"
+                    )
+        if md["completed"] != N_REQUESTS:
+            fail(
+                f"--replicas smoke run must complete all {N_REQUESTS} "
+                f"requests, got {md['completed']}"
+            )
+        # the bundle is the supervisor's: control-plane counters in the
+        # exposition, routed events in the timeline
+        ppath = os.path.join(tdir, "metrics.prom")
+        if not os.path.exists(ppath):
+            fail("--replicas --telemetry-dir did not produce metrics.prom")
+        prom = open(ppath, encoding="utf-8").read()
+        for needle in ("serve_replica_failovers_total", "serve_hedges_total",
+                       "serve_hedge_wasted_tokens_total",
+                       "serve_drains_total"):
+            if needle not in prom:
+                fail(f"--replicas metrics.prom lacks {needle!r}")
+        epath = os.path.join(tdir, "events.jsonl")
+        try:
+            lines = open(epath, encoding="utf-8").read().splitlines()
+        except OSError as e:
+            fail(f"--replicas events.jsonl unreadable: {e}")
+        names = set()
+        for line in lines[1:]:
+            try:
+                names.add(json.loads(line)["name"])
+            except (json.JSONDecodeError, KeyError) as e:
+                fail(f"--replicas events.jsonl malformed line: {e}")
+        if "routed" not in names:
+            fail(
+                "--replicas events.jsonl lacks 'routed' control-plane "
+                f"events (names seen: {sorted(names)})"
+            )
+
+
 def main() -> None:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -358,11 +502,14 @@ def main() -> None:
                        'le="+Inf"', "serve_submitted_total"):
             if needle not in prom:
                 fail(f"metrics.prom lacks {needle!r}")
+    check_replica_mode(env, repo)
     print(
         f"check_metrics_schema: OK — {len(REQUIRED_METRIC_KEYS)} metric "
         f"keys on both surfaces, {N_REQUESTS} complete request spans "
         f"across {n_events} events, {n_trace} trace events, prom "
-        "exposition present"
+        f"exposition present; --replicas 2 line carries "
+        f"{len(REQUIRED_REPLICA_KEYS)} control-plane keys + "
+        f"{len(REQUIRED_PER_REPLICA_KEYS)} per-replica keys"
     )
 
 

@@ -96,6 +96,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "paged KV-cache gate (allocator / prefix cache / paged-decode parity)"
   python -m pytest tests/test_paging.py -q
 
+  step "supervisor gate (replica failover / hedging / drain chaos drills)"
+  python -m pytest tests/test_serve_supervisor.py -q
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
