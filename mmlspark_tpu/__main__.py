@@ -87,6 +87,8 @@ def cmd_serve(args) -> int:
         prefix_cache=args.prefix_cache,
         replicas=args.replicas,
         hedge_ms=args.hedge_ms,
+        kv_dtype=args.kv_dtype,
+        quantize_weights=args.quantize_weights,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -272,6 +274,22 @@ def main(argv: list[str] | None = None) -> int:
         "prompt hash, later prompts map them refcounted and prefill "
         "only the remainder (copy-on-extend on divergence); the JSON "
         "line grows prefix_cache_hits_total / cow_copies_total",
+    )
+    sp.add_argument(
+        "--kv-dtype", choices=["bf16", "int8"], default="bf16",
+        help="KV-cache store dtype: int8 halves the pool's HBM bytes "
+        "(per-head scales on the dense pool, per-page on --paged; the "
+        "decode kernels dequantize in-VMEM) at a declared token-flip "
+        "budget vs the bf16 oracle; requires an even head_dim "
+        "(docs/PERFORMANCE.md 'Quantized decode')",
+    )
+    sp.add_argument(
+        "--quantize-weights", action="store_true",
+        help="serve with per-channel int8 weights, dequantized inside "
+        "each jitted program: ~2x less weight HBM per decode dispatch; "
+        "with --mesh the quantized params replicate instead of "
+        "tensor-parallel sharding (docs/PERFORMANCE.md 'Quantized "
+        "decode')",
     )
     sp.add_argument(
         "--replicas", type=int, default=1, metavar="N",

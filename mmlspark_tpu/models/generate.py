@@ -71,6 +71,13 @@ def cache_geometry(graph, variables) -> dict:
                 "geometry is read from (params/attn/qkv/kernel); cached "
                 "decode requires the transformer attention layout"
             ) from e
+        if isinstance(kern, dict):
+            # weight-quantized variables (ops/quantize.py) replace the
+            # kernel with {int8 payload, scale}; the payload keeps the
+            # original kernel shape the geometry is read from
+            from mmlspark_tpu.ops.quantize import _Q8
+
+            kern = kern[_Q8]
         geometry[name] = (hk, kern.shape[1] // (heads + 2 * hk))
     return geometry
 

@@ -148,12 +148,14 @@ class SelfAttention(nn.Module):
                     "per-row cache positions (the serve engine's fused "
                     "decode step) are single-token and linear-cache only"
                 )
-            if len(cache) == 3:
+            if len(cache) in (3, 5):
                 # PAGED slot cache (mmlspark_tpu/serve/paging.py): K/V
                 # are physical page stores (num_pages, hk, page_size, d)
                 # shared by all rows, plus a (B, max_pages) page table
                 # mapping each row's logical positions through its pages.
-                # This is strictly the serve engine's fused decode-block
+                # The 5-tuple is the int8 page store: two extra
+                # (num_pages, hk) f32 per-page scale leaves. This is
+                # strictly the serve engine's fused decode-block
                 # format — prefill runs on a linear batch-1 cache and
                 # the pool scatters it into pages host-side.
                 if not (per_row and decode and t == 1):
@@ -162,7 +164,7 @@ class SelfAttention(nn.Module):
                         "only (the serve engine's fused decode step); "
                         "prefill uses the linear cache path"
                     )
-                ck, cv, ptab = cache
+                ck, cv, ptab, *cscales = cache
                 ps = ck.shape[2]
                 virt = ptab.shape[1] * ps
                 if self.window is not None and self.window < virt:
@@ -180,11 +182,42 @@ class SelfAttention(nn.Module):
                 pages = ptab[rows, pos // ps]
                 offs = pos % ps
                 hidx = jnp.arange(ck.shape[1])
+                if cscales:
+                    # int8 page store: a page's scale is FIXED at its
+                    # first write — offs == 0 means this token opens a
+                    # fresh page (ensure_decode_pages pre-mapped it),
+                    # so its amax (+ headroom) becomes the page's
+                    # scale; later tokens into the page quantize
+                    # against it and saturate into the error budget.
+                    # Dead rows re-stamp their trash page's scale,
+                    # which nothing ever reads (live length 0).
+                    from mmlspark_tpu.serve.cache_pool import (
+                        kv_head_scales, quantize_kv,
+                    )
+
+                    ks, vs = cscales
+                    tk = k[:, 0].astype(jnp.float32)
+                    tv = v[:, 0].astype(jnp.float32)
+                    first = (offs == 0)[:, None]
+                    row_ks = jnp.where(
+                        first, kv_head_scales(tk, axes=(2,)), ks[pages]
+                    )
+                    row_vs = jnp.where(
+                        first, kv_head_scales(tv, axes=(2,)), vs[pages]
+                    )
+                    ks = ks.at[pages].set(row_ks)
+                    vs = vs.at[pages].set(row_vs)
+                    cscales = [ks, vs]
+                    wk = quantize_kv(tk, row_ks)
+                    wv = quantize_kv(tv, row_vs)
+                else:
+                    wk = k[:, 0].astype(ck.dtype)
+                    wv = v[:, 0].astype(cv.dtype)
                 ck = ck.at[pages[:, None], hidx[None, :], offs[:, None]
-                           ].set(k[:, 0].astype(ck.dtype))
+                           ].set(wk)
                 cv = cv.at[pages[:, None], hidx[None, :], offs[:, None]
-                           ].set(v[:, 0].astype(cv.dtype))
-                new_cache = (ck, cv, ptab)
+                           ].set(wv)
+                new_cache = (ck, cv, ptab, *cscales)
                 from mmlspark_tpu.ops.attention import decode_live_lengths
                 from mmlspark_tpu.ops.flash_attention import (
                     paged_flash_decode,
@@ -193,16 +226,44 @@ class SelfAttention(nn.Module):
                 o = paged_flash_decode(
                     q, ck, cv, decode_live_lengths(pos, b, live=live),
                     ptab,
+                    k_scale=cscales[0] if cscales else None,
+                    v_scale=cscales[1] if cscales else None,
                 )
             else:
-                ck, cv = cache
+                ck, cv, *cscales = cache
+                if cscales and not (
+                    per_row and decode and t == 1
+                    and (self.window is None
+                         or self.window >= ck.shape[1])
+                ):
+                    # the 4-tuple is the slot pool's int8 mode; only
+                    # the flash-decode read below can dequantize it
+                    raise ParamError(
+                        "int8 dense caches serve the engine's per-row "
+                        "single-token full-window decode only; prefill "
+                        "and single-request generate use bf16 linear "
+                        "caches"
+                    )
                 if per_row:
                     # multi-tenant decode (mmlspark_tpu.serve): every
                     # batch row is a different request writing its own
                     # absolute position in its own slot buffer
                     rows = jnp.arange(b)
-                    ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
-                    cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+                    if cscales:
+                        # quantize the step's K/V against the slots'
+                        # prefill-fixed scales (out-of-range values
+                        # saturate — priced into the parity budget)
+                        from mmlspark_tpu.serve.cache_pool import (
+                            quantize_kv,
+                        )
+
+                        wk = quantize_kv(k[:, 0], cscales[0])
+                        wv = quantize_kv(v[:, 0], cscales[1])
+                    else:
+                        wk = k[:, 0].astype(ck.dtype)
+                        wv = v[:, 0].astype(cv.dtype)
+                    ck = ck.at[rows, pos].set(wk)
+                    cv = cv.at[rows, pos].set(wv)
                 else:
                     # rolled (O(window) circular, sliding-window models
                     # on long generations): this step's K/V land at slot
@@ -217,7 +278,7 @@ class SelfAttention(nn.Module):
                     cv = jax.lax.dynamic_update_slice(
                         cv, v.astype(cv.dtype), (0, idx, 0, 0)
                     )
-                new_cache = (ck, cv)
+                new_cache = (ck, cv, *cscales)
                 if rolled:
                     from mmlspark_tpu.ops.attention import (
                         rolled_window_attention,
@@ -246,7 +307,10 @@ class SelfAttention(nn.Module):
                     # carry) zeroes dead rows' lengths, so the kernel's
                     # early-out skips their cache traffic mid-block
                     o = flash_decode(
-                        q, ck, cv, decode_live_lengths(pos, b, live=live)
+                        q, ck, cv,
+                        decode_live_lengths(pos, b, live=live),
+                        k_scale=cscales[0] if cscales else None,
+                        v_scale=cscales[1] if cscales else None,
                     )
                 else:
                     o = dense_attention(q, ck, cv, causal=True,

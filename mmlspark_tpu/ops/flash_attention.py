@@ -637,6 +637,93 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         ).astype(o_ref.dtype)
 
 
+def _decode_kernel_q8(len_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *,
+                      scale: float, blk: int, heads: int, group: int):
+    """:func:`_decode_kernel` over int8 K/V with per-(row, kv-head) f32
+    scales riding the scalar-prefetch channel next to the lengths
+    (docs/PERFORMANCE.md "Quantized decode"). HBM→VMEM traffic is the
+    int8 bytes; the dequant is an in-VMEM ``astype`` whose scale folds
+    into scalars the online softmax already multiplies by — ``k_scale``
+    into the softmax scale, ``v_scale`` onto each block's P·V
+    contribution — so the carry algebra stays f32 and unchanged."""
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    length = len_ref[bh // heads]
+    # bh // group is the flattened (batch, kv-head) row — the same
+    # coordinate the kv index map fetches K/V blocks with
+    ks = ks_ref[bh // group]
+    vs = vs_ref[bh // group]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kb * blk < length)
+    def _update():
+        q = jnp.broadcast_to(
+            q_ref[0].astype(jnp.float32), (SUBLANES, q_ref.shape[-1])
+        )
+        s = jax.lax.dot_general(
+            q, k_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * ks)  # k dequant scale folded into the softmax scale
+        kpos = kb * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos >= length, NEG_INF, s)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * vs  # v dequant scale applied per block contribution
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_scr[:1, :1]
+        o_ref[0] = (
+            acc_scr[:1] / jnp.where(l == 0.0, 1.0, l)
+        ).astype(o_ref.dtype)
+
+
+def _validate_kv_scales(q, kv_dtype, hk: int, b: int, k_scale, v_scale,
+                        d: int, name: str):
+    """Shared int8-mode argument contract for both decode kernels:
+    int8 K/V requires BOTH f32 scale arrays and a float query; float
+    K/V must not pass scales (a silent no-op scale would mask a pool
+    wiring bug). Returns True when the int8 path is active."""
+    quantized = kv_dtype == jnp.int8
+    if quantized:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                f"{name}: int8 K/V requires k_scale and v_scale"
+            )
+        if not jnp.issubdtype(q.dtype, jnp.floating):
+            raise ValueError(
+                f"{name}: int8 K/V needs a float query, got {q.dtype}"
+            )
+        if d % 2:
+            raise ValueError(
+                f"{name}: int8 K/V requires an even head_dim (int8 "
+                f"lanes pack pairwise in the VREG tile), got {d}"
+            )
+    elif k_scale is not None or v_scale is not None:
+        raise ValueError(
+            f"{name}: k_scale/v_scale are int8-mode arguments; K/V "
+            f"here are {kv_dtype}"
+        )
+    return quantized
+
+
 def _decode_block(cache_len: int, block: int) -> int:
     """Largest divisor of ``cache_len`` in [8, block] when one exists —
     dividing evenly means the cache streams with NO pad copy, which is
@@ -649,8 +736,16 @@ def _decode_block(cache_len: int, block: int) -> int:
 
 
 def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
-                 interpret: bool | None = None):
+                 interpret: bool | None = None,
+                 k_scale=None, v_scale=None):
     """Length-aware split-KV attention for ONE query token per row.
+
+    int8 mode: when ``k``/``v`` are int8, ``k_scale``/``v_scale`` —
+    (B, Hkv) f32, the dense pool's per-(slot, kv-head) quantization
+    scales — must be passed; they ride the scalar-prefetch channel
+    next to ``lengths`` and the kernel dequantizes in-VMEM (HBM
+    streams half the bytes of bf16; softmax math stays f32). ``q``
+    stays float and sets the output dtype.
 
     ``q`` is (B, 1, H, D) — a single decode step; ``k``/``v`` are the
     (B, L, Hkv, D) slot caches (GQA as in :func:`flash_attention`);
@@ -670,10 +765,10 @@ def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
     compiled on TPU, interpreter elsewhere so CPU tests run the same
     code path.
     """
-    if not (q.dtype == k.dtype == v.dtype):
+    if k.dtype != v.dtype:
         raise ValueError(
-            "flash_decode requires q, k, v to share one dtype, got "
-            f"{q.dtype}/{k.dtype}/{v.dtype}"
+            f"flash_decode requires k and v to share one dtype, got "
+            f"{k.dtype}/{v.dtype}"
         )
     if q.ndim != 4 or q.shape[1] != 1:
         raise ValueError(
@@ -686,6 +781,23 @@ def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
             f"got q={q.shape[2]} k={k.shape[2]} v={v.shape[2]}"
         )
     b, _, h, d = q.shape
+    quantized = _validate_kv_scales(
+        q, k.dtype, k.shape[2], b, k_scale, v_scale, d, "flash_decode"
+    )
+    if not quantized and q.dtype != k.dtype:
+        raise ValueError(
+            "flash_decode requires q, k, v to share one dtype, got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}"
+        )
+    if quantized:
+        k_scale = jnp.asarray(k_scale, jnp.float32)
+        v_scale = jnp.asarray(v_scale, jnp.float32)
+        want = (b, k.shape[2])
+        if k_scale.shape != want or v_scale.shape != want:
+            raise ValueError(
+                f"flash_decode int8 scales must be {want} — one f32 per "
+                f"(row, kv head) — got {k_scale.shape}/{v_scale.shape}"
+            )
     L = k.shape[1]
     lengths = jnp.asarray(lengths)
     if lengths.shape != (b,):
@@ -709,22 +821,39 @@ def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
     vb = _to_bh(v, l_pad)
     n_blk = l_pad // blk
 
-    def kv_im(bh, j, lens):
+    def kv_im(bh, j, lens, *scales):
         # clamp at the row's last LIVE block: dead iterations re-reference
         # the resident tile, so their DMAs never issue (block-level
         # early-out). bh // g maps query-head rows onto kv-head rows
-        # (bh//g == batch*hkv + qh//group, g dividing h).
+        # (bh//g == batch*hkv + qh//group, g dividing h). *scales absorbs
+        # the int8 mode's extra scalar-prefetch refs, unused here.
         length = lens[bh // h]
         last = jnp.maximum((length + blk - 1) // blk - 1, 0)
         return (bh // g, jnp.minimum(j, last), 0)
 
+    if quantized:
+        # per-(row, kv-head) scales flattened to the kernel's bh // g
+        # coordinate, scalar-prefetched alongside the live lengths
+        kernel = partial(
+            _decode_kernel_q8, scale=scale, blk=blk, heads=h, group=g,
+        )
+        n_prefetch = 3
+        operands = (
+            lengths, k_scale.reshape(-1), v_scale.reshape(-1), qb, kb, vb,
+        )
+    else:
+        kernel = partial(_decode_kernel, scale=scale, blk=blk, heads=h)
+        n_prefetch = 1
+        operands = (lengths, qb, kb, vb)
+
     out = pl.pallas_call(
-        partial(_decode_kernel, scale=scale, blk=blk, heads=h),
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=n_prefetch,
             grid=(b * h, n_blk),
             in_specs=[
-                pl.BlockSpec((1, 1, d), lambda bh, j, lens: (bh, 0, 0),
+                pl.BlockSpec((1, 1, d),
+                             lambda bh, j, lens, *scales: (bh, 0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, blk, d), kv_im,
                              memory_space=pltpu.VMEM),
@@ -732,7 +861,7 @@ def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
                              memory_space=pltpu.VMEM),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, d), lambda bh, j, lens: (bh, 0, 0),
+                (1, 1, d), lambda bh, j, lens, *scales: (bh, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             scratch_shapes=[
@@ -744,7 +873,7 @@ def flash_decode(q, k, v, lengths, *, scale=None, block: int = 128,
         out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
         compiler_params=_DECODE_SEMANTICS,
         interpret=bool(interpret),
-    )(lengths, qb, kb, vb)
+    )(*operands)
     return _from_bh(out, b, h, 1)
 
 
@@ -810,9 +939,79 @@ def _paged_decode_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
         ).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel_q8(len_ref, pt_ref, ks_ref, vs_ref,
+                            q_ref, k_ref, v_ref, o_ref,
+                            m_scr, l_scr, acc_scr, *,
+                            scale: float, blk: int, heads: int,
+                            group: int):
+    """:func:`_paged_decode_kernel` over int8 pages with PER-PAGE
+    f32 scales scalar-prefetched next to the lengths and page table.
+    Inside a live block the logical page coordinate ``kb`` is already
+    valid (the ``pl.when`` guard implies ``kb <= last``), so the
+    kernel reads the same table entry the index map fetched the page
+    with and looks its scales up directly — V's scale varies per page,
+    so it lands on each block's P·V contribution before accumulation,
+    which is exactly where per-page granularity is exact."""
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    row = bh // heads
+    length = len_ref[row]
+    kvh = (bh % heads) // group
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kb * blk < length)
+    def _update():
+        page = pt_ref[row, kb]
+        ks = ks_ref[page, kvh]
+        vs = vs_ref[page, kvh]
+        q = jnp.broadcast_to(
+            q_ref[0].astype(jnp.float32), (SUBLANES, q_ref.shape[-1])
+        )
+        s = jax.lax.dot_general(
+            q, k_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * ks)
+        kpos = kb * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos >= length, NEG_INF, s)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * vs
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_scr[:1, :1]
+        o_ref[0] = (
+            acc_scr[:1] / jnp.where(l == 0.0, 1.0, l)
+        ).astype(o_ref.dtype)
+
+
 def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
-                       scale=None, interpret: bool | None = None):
+                       scale=None, interpret: bool | None = None,
+                       k_scale=None, v_scale=None):
     """:func:`flash_decode` over PAGED caches.
+
+    int8 mode: when the page stores are int8, ``k_scale``/``v_scale``
+    — (num_pages, Hkv) f32, the paged pool's PER-PAGE quantization
+    scales — must be passed; they scalar-prefetch alongside the
+    lengths and page table and the kernel dequantizes each fetched
+    page face in-VMEM, so the page-store HBM traffic halves vs bf16
+    while the softmax carry stays f32.
 
     ``q`` is (B, 1, H, D); ``k_pages``/``v_pages`` are the physical page
     stores ``(num_pages, Hkv, page_size, D)`` shared by all rows;
@@ -829,10 +1028,10 @@ def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
     map: the live-length clamp picks the logical block, the table turns
     it physical. Per-row work and HBM traffic remain O(lengths[b]).
     """
-    if not (q.dtype == k_pages.dtype == v_pages.dtype):
+    if k_pages.dtype != v_pages.dtype:
         raise ValueError(
-            "paged_flash_decode requires q, k, v to share one dtype, got "
-            f"{q.dtype}/{k_pages.dtype}/{v_pages.dtype}"
+            f"paged_flash_decode requires k and v pages to share one "
+            f"dtype, got {k_pages.dtype}/{v_pages.dtype}"
         )
     if q.ndim != 4 or q.shape[1] != 1:
         raise ValueError(
@@ -850,6 +1049,25 @@ def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
             f"heads, got q={q.shape[2]} kv={k_pages.shape[1]}"
         )
     b, _, h, d = q.shape
+    quantized = _validate_kv_scales(
+        q, k_pages.dtype, k_pages.shape[1], b, k_scale, v_scale, d,
+        "paged_flash_decode",
+    )
+    if not quantized and q.dtype != k_pages.dtype:
+        raise ValueError(
+            "paged_flash_decode requires q, k, v to share one dtype, got "
+            f"{q.dtype}/{k_pages.dtype}/{v_pages.dtype}"
+        )
+    if quantized:
+        k_scale = jnp.asarray(k_scale, jnp.float32)
+        v_scale = jnp.asarray(v_scale, jnp.float32)
+        want = (k_pages.shape[0], k_pages.shape[1])
+        if k_scale.shape != want or v_scale.shape != want:
+            raise ValueError(
+                f"paged_flash_decode int8 scales must be {want} — one "
+                f"f32 per (page, kv head) — got "
+                f"{k_scale.shape}/{v_scale.shape}"
+            )
     ps = k_pages.shape[2]
     if ps % SUBLANES:
         raise ValueError(
@@ -882,23 +1100,38 @@ def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
 
     qb = _to_bh(q, 1)  # (B*H, 1, D)
 
-    def kv_im(bh, j, lens, pt):
+    def kv_im(bh, j, lens, pt, *scales):
         # same last-live-block clamp as flash_decode, then the page
         # table makes the surviving LOGICAL coordinate physical; the
-        # head coordinate picks the kv head inside the page
+        # head coordinate picks the kv head inside the page. *scales
+        # absorbs the int8 mode's extra scalar-prefetch refs.
         row = bh // h
         length = lens[row]
         last = jnp.maximum((length + ps - 1) // ps - 1, 0)
         page = pt[row, jnp.minimum(j, last)]
         return (page, (bh % h) // g, 0, 0)
 
+    if quantized:
+        kernel = partial(
+            _paged_decode_kernel_q8, scale=scale, blk=ps, heads=h, group=g,
+        )
+        n_prefetch = 4
+        operands = (
+            lengths, page_table, k_scale, v_scale, qb, k_pages, v_pages,
+        )
+    else:
+        kernel = partial(_paged_decode_kernel, scale=scale, blk=ps, heads=h)
+        n_prefetch = 2
+        operands = (lengths, page_table, qb, k_pages, v_pages)
+
     out = pl.pallas_call(
-        partial(_paged_decode_kernel, scale=scale, blk=ps, heads=h),
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=n_prefetch,
             grid=(b * h, n_pages),
             in_specs=[
-                pl.BlockSpec((1, 1, d), lambda bh, j, lens, pt: (bh, 0, 0),
+                pl.BlockSpec((1, 1, d),
+                             lambda bh, j, lens, pt, *scales: (bh, 0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, 1, ps, d), kv_im,
                              memory_space=pltpu.VMEM),
@@ -906,7 +1139,7 @@ def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
                              memory_space=pltpu.VMEM),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, d), lambda bh, j, lens, pt: (bh, 0, 0),
+                (1, 1, d), lambda bh, j, lens, pt, *scales: (bh, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             scratch_shapes=[
@@ -918,5 +1151,5 @@ def paged_flash_decode(q, k_pages, v_pages, lengths, page_table, *,
         out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
         compiler_params=_DECODE_SEMANTICS,
         interpret=bool(interpret),
-    )(lengths, page_table, qb, k_pages, v_pages)
+    )(*operands)
     return _from_bh(out, b, h, 1)

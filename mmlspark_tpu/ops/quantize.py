@@ -28,7 +28,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["quantize_weights", "dequantize_weights"]
+__all__ = [
+    "quantize_weights", "dequantize_weights", "quantized_bytes",
+    "kv_cache_bytes",
+]
 
 #: marker key: a dict {_Q8: int8 array, _SCALE: f32 per-channel scale}
 #: stands in for the original float leaf (pytree-transparent: device_put,
@@ -43,9 +46,16 @@ def _is_quantized_leaf(x: Any) -> bool:
     return isinstance(x, dict) and _Q8 in x and _SCALE in x
 
 
-def quantize_weights(variables: Any) -> Any:
+def quantize_weights(variables: Any, *,
+                     min_size: int = _MIN_QUANT_SIZE) -> Any:
     """Per-output-channel symmetric int8 for every float leaf with
-    ndim >= 2 and size >= 4096; everything else passes through."""
+    ndim >= 2 and size >= ``min_size``; everything else passes through.
+
+    ``min_size`` defaults to the batch-inference threshold (tiny tensors
+    carry no bandwidth to win). The serving engine passes ``min_size=0``
+    so EVERY projection/MLP kernel in the fused decode block goes int8 —
+    at decode batch sizes each dispatch streams the whole weight set for
+    a handful of FLOPs, so even small matmuls are bandwidth-bound."""
 
     def one(leaf):
         a = np.asarray(leaf)
@@ -54,7 +64,7 @@ def quantize_weights(variables: Any) -> Any:
         # weights — the exact tensors worth quantizing
         if (
             a.ndim < 2
-            or a.size < _MIN_QUANT_SIZE
+            or a.size < min_size
             or not jnp.issubdtype(a.dtype, jnp.floating)
         ):
             return leaf
@@ -87,7 +97,15 @@ def dequantize_weights(variables: Any, dtype=jnp.bfloat16) -> Any:
 
 
 def quantized_bytes(variables: Any) -> tuple[int, int]:
-    """(bytes as stored, bytes if f32) — the bandwidth win, for logging."""
+    """(bytes as stored, bytes if f32) — the bandwidth win, for logging.
+
+    Accepts ANY pytree of arrays, not just weight pytrees: KV-cache
+    buffer trees (dense ``{block: (k, v)}`` slabs, int8
+    ``(k, v, k_scale, v_scale)`` tuples, paged ``(k, v, page_table,
+    ...)`` tuples) are traversed leaf-by-leaf, so the int8 pools'
+    scale leaves and the paged pools' page tables count toward the
+    stored figure exactly as HBM holds them. Device arrays are sized
+    from their ``nbytes``/``size`` attributes — no host transfer."""
     stored = 0
     f32 = 0
     for leaf in jax.tree_util.tree_leaves(
@@ -96,8 +114,33 @@ def quantized_bytes(variables: Any) -> tuple[int, int]:
         if _is_quantized_leaf(leaf):
             stored += leaf[_Q8].size + leaf[_SCALE].size * 4
             f32 += leaf[_Q8].size * 4
+        elif hasattr(leaf, "nbytes") and hasattr(leaf, "size"):
+            stored += int(leaf.nbytes)
+            f32 += int(leaf.size) * 4
         else:
             a = np.asarray(leaf)
             stored += a.nbytes
             f32 += a.size * 4
     return stored, f32
+
+
+def kv_cache_bytes(buffers: Any) -> tuple[int, int]:
+    """(bytes as stored, bytes if bf16) for a cache pool's buffer
+    pytree — the KV analog of :func:`quantized_bytes`, with the
+    baseline at bf16 because that is what the dense accuracy-oracle
+    pool stores. int8 K/V leaves count 1 byte against a 2-byte
+    baseline (~2x saved); f32 scale leaves and int32 page tables are
+    quantization/paging overhead, so they count toward stored AND
+    baseline at their own width (an int8 pool is never reported as
+    beating a bf16 pool it doesn't actually beat)."""
+    stored = 0
+    bf16 = 0
+    for leaf in jax.tree_util.tree_leaves(buffers):
+        nbytes = int(leaf.nbytes)
+        size = int(leaf.size)
+        stored += nbytes
+        if leaf.dtype == jnp.int8:
+            bf16 += size * 2  # the values a bf16 pool would store
+        else:
+            bf16 += nbytes
+    return stored, bf16

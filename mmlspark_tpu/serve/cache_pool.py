@@ -43,6 +43,59 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.models.generate import cache_geometry
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
+#: headroom multiplied onto the prefill amax when fixing a slot's int8
+#: quantization scale: decode steps quantize with the SAME scale
+#: in-graph (a per-step rescale would invalidate already-written int8
+#: rows), so the margin absorbs decode K/V drifting above the prompt's
+#: range; values beyond it saturate at ±127 — graceful, and part of the
+#: declared error budget (docs/PERFORMANCE.md "Quantized decode")
+KV_SCALE_MARGIN = 1.5
+
+VALID_KV_DTYPES = ("bf16", "int8")
+
+
+def validate_kv_dtype(kv_dtype: str, geometry: dict) -> None:
+    """Shared pool-level contract for ``kv_dtype`` (dense and paged
+    pools): the flag must name a supported dtype, and int8 requires an
+    even head_dim — the decode kernels' int8 VREG tile packs lanes
+    pairwise and rejects odd D (the CLI surfaces this as the
+    FriendlyError, not a kernel shape crash mid-serve)."""
+    if kv_dtype not in VALID_KV_DTYPES:
+        raise FriendlyError(
+            f"kv_dtype must be one of {VALID_KV_DTYPES}, got "
+            f"{kv_dtype!r}"
+        )
+    if kv_dtype == "int8":
+        for name, (hk, d) in geometry.items():
+            if d % 2:
+                raise FriendlyError(
+                    f"kv_dtype='int8' requires an even head_dim (the "
+                    f"int8 decode-kernel tile packs lanes pairwise), "
+                    f"but block '{name}' has head_dim {d}. Use "
+                    f"kv_dtype='bf16' or an even d_model/heads split"
+                )
+
+
+def quantize_kv(values, scales):
+    """Symmetric int8 quantization of K/V ``values`` (..., hk, d) with
+    per-kv-head ``scales`` broadcastable over (..., hk); out-of-range
+    values saturate at ±127. ONE definition shared by the pools' eager
+    prefill writes and the transformer's in-graph decode-step writes,
+    so both paths land bit-identical int8 for identical inputs."""
+    q = jnp.round(values.astype(jnp.float32) / scales[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def kv_head_scales(values, axes) -> jnp.ndarray:
+    """Per-kv-head f32 quantization scales from the amax of ``values``
+    over ``axes`` (every dim but the kv-head dim), with the
+    ``KV_SCALE_MARGIN`` headroom and a 1.0 floor substituted for
+    all-zero heads (a zero scale would divide by zero; scale 1.0 maps
+    zeros to zeros exactly)."""
+    amax = jnp.abs(values.astype(jnp.float32)).max(axis=axes)
+    scale = amax * (KV_SCALE_MARGIN / 127.0)
+    return jnp.where(scale == 0.0, 1.0, scale)
+
 
 class SlotCachePool:
     """Preallocated per-block K/V buffers with slot lease/free accounting.
@@ -52,10 +105,21 @@ class SlotCachePool:
     ``(slots, cache_len, hk, d)`` bf16. The pool owns the host-side
     bookkeeping (which slots are leased); the arrays themselves stay on
     device and are replaced functionally each tick.
+
+    ``kv_dtype="int8"`` (docs/PERFORMANCE.md "Quantized decode") stores
+    K/V as int8 — HALF the bf16 pool's HBM bytes — and each block's
+    entry grows to ``(K, V, k_scale, v_scale)`` with (slots, hk) f32
+    per-(slot, kv-head) scales as extra cache-pytree leaves: prefill
+    fixes a slot's scales from its prompt amax (+ headroom), decode
+    steps quantize in-graph against them, and the flash-decode kernel
+    dequantizes in-VMEM. All four leaves are DISTINCT arrays (donation)
+    and all four carry pinned shardings under a mesh. The bf16 mode is
+    unchanged — it remains the accuracy oracle the int8 parity suite
+    measures against.
     """
 
     def __init__(self, graph, variables, slots: int, cache_len: int, *,
-                 mesh=None):
+                 mesh=None, kv_dtype: str = "bf16"):
         if slots < 1:
             raise FriendlyError(f"slots must be >= 1, got {slots}")
         if cache_len < 2:
@@ -82,8 +146,12 @@ class SlotCachePool:
                     "natural pad rows — dead on device, zero decode "
                     "cost beyond the fixed shapes) or shrink the axis"
                 )
+        validate_kv_dtype(kv_dtype, geometry)
+        self.kv_dtype = kv_dtype
         self.num_slots = slots
         self.cache_len = cache_len
+        quantized = kv_dtype == "int8"
+        store_dtype = jnp.int8 if quantized else jnp.bfloat16
         # device-placement anchors under a mesh; None on a single device
         self._slot_sharding = self._kv_shardings = None
         if mesh is not None:
@@ -100,18 +168,33 @@ class SlotCachePool:
                     MODEL_AXIS if msize > 1 and hk % msize == 0 else None
                 )
                 sh = NamedSharding(mesh, P(DATA_AXIS, None, head, None))
-                self._kv_shardings[name] = (sh, sh)
+                if quantized:
+                    # (slots, hk) scale leaves shard exactly like the
+                    # dims they index: slots over data, heads over model
+                    ssc = NamedSharding(mesh, P(DATA_AXIS, head))
+                    self._kv_shardings[name] = (sh, sh, ssc, ssc)
+                else:
+                    self._kv_shardings[name] = (sh, sh)
         self.buffers = {}
         for name, (hk, d) in geometry.items():
             # K and V must be DISTINCT arrays: the engine's decode step
             # donates the whole buffer pytree (donate_argnums), and a
-            # pair aliasing one allocation cannot be donated twice
-            k = jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16)
-            v = jnp.zeros((slots, cache_len, hk, d), jnp.bfloat16)
+            # pair aliasing one allocation cannot be donated twice —
+            # same for the int8 mode's two scale leaves
+            k = jnp.zeros((slots, cache_len, hk, d), store_dtype)
+            v = jnp.zeros((slots, cache_len, hk, d), store_dtype)
+            entry = (k, v)
+            if quantized:
+                entry = (
+                    k, v,
+                    jnp.ones((slots, hk), jnp.float32),
+                    jnp.ones((slots, hk), jnp.float32),
+                )
             if self._kv_shardings is not None:
-                sk, sv = self._kv_shardings[name]
-                k, v = jax.device_put(k, sk), jax.device_put(v, sv)
-            self.buffers[name] = (k, v)
+                entry = tuple(jax.device_put(
+                    entry, self._kv_shardings[name]
+                ))
+            self.buffers[name] = entry
         # LIFO free list popping the lowest id first keeps slot
         # assignment deterministic for the parity tests
         self._free = list(range(slots - 1, -1, -1))
@@ -195,6 +278,21 @@ class SlotCachePool:
             self.positions.at[slot].set(0),
             self.live.at[slot].set(False),
         )
+        if self.kv_dtype == "int8":
+            # release the slot's quantization-scale state back to the
+            # 1.0 init: a freed (quarantined/preempted/retired) lease
+            # must not leak its calibration into the next tenant, and
+            # the parity tests assert the reset
+            new_buffers = {}
+            for name, (k, v, ks, vs) in self.buffers.items():
+                new_buffers[name] = (
+                    k, v, ks.at[slot].set(1.0), vs.at[slot].set(1.0),
+                )
+            if self._kv_shardings is not None:
+                new_buffers = jax.device_put(
+                    new_buffers, self._kv_shardings
+                )
+            self.buffers = new_buffers
 
     def _commit_slot_pair(self, positions, live) -> None:
         """Rebind positions+live behind ONE pinned update — committing
@@ -222,12 +320,33 @@ class SlotCachePool:
                 f"prefill length {length} exceeds the pool's cache_len "
                 f"{self.cache_len}"
             )
+        quantized = self.kv_dtype == "int8"
         new_buffers = {}
-        for name, (pk, pv) in self.buffers.items():
+        for name, entry in self.buffers.items():
             ck, cv = prefill_cache[name]
-            nk = pk.at[slot, :length].set(ck[0, :length].astype(pk.dtype))
-            nv = pv.at[slot, :length].set(cv[0, :length].astype(pv.dtype))
-            new_buffers[name] = (nk, nv)
+            if quantized:
+                pk, pv, pks, pvs = entry
+                # the prompt amax (+ margin) FIXES this lease's scales:
+                # decode steps quantize against them in-graph, so they
+                # must be set before the first block dispatch
+                ck0, cv0 = ck[0, :length], cv[0, :length]
+                k_scl = kv_head_scales(ck0, axes=(0, 2))  # (hk,)
+                v_scl = kv_head_scales(cv0, axes=(0, 2))
+                nk = pk.at[slot, :length].set(quantize_kv(ck0, k_scl))
+                nv = pv.at[slot, :length].set(quantize_kv(cv0, v_scl))
+                new_buffers[name] = (
+                    nk, nv,
+                    pks.at[slot].set(k_scl), pvs.at[slot].set(v_scl),
+                )
+            else:
+                pk, pv = entry
+                nk = pk.at[slot, :length].set(
+                    ck[0, :length].astype(pk.dtype)
+                )
+                nv = pv.at[slot, :length].set(
+                    cv[0, :length].astype(pv.dtype)
+                )
+                new_buffers[name] = (nk, nv)
         if self._kv_shardings is not None:
             # the eager scatters' output shardings are whatever GSPMD
             # propagated from mixing the pool rows with the prefill

@@ -39,7 +39,9 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              page_size: int | None = None,
              prefix_cache: bool = False,
              replicas: int = 1,
-             hedge_ms: float | None = None) -> dict:
+             hedge_ms: float | None = None,
+             kv_dtype: str = "bf16",
+             quantize_weights: bool = False) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line. With ``replicas > 1`` the loop drives
     a :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet` instead of a
@@ -74,6 +76,9 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         # --paged/--page-size/--prefix-cache -> the paged KV-cache pool
         # (docs/SERVING.md "Paged KV cache"); dense slot pool otherwise
         paged=paged, page_size=page_size, prefix_cache=prefix_cache,
+        # --kv-dtype int8 / --quantize-weights -> the quantized decode
+        # hot path (docs/PERFORMANCE.md "Quantized decode")
+        kv_dtype=kv_dtype, quantize_weights=quantize_weights,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
     )

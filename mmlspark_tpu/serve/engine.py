@@ -121,7 +121,9 @@ class ServeEngine:
                  num_pages: int | None = None,
                  prefix_cache: bool = False,
                  replica: int | None = None,
-                 snapshot_every_ticks: int | None = None):
+                 snapshot_every_ticks: int | None = None,
+                 kv_dtype: str = "bf16",
+                 quantize_weights: bool = False):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -175,10 +177,44 @@ class ServeEngine:
         # single-device engine, and the compile-count pins hold because
         # every per-tick input is committed to a fixed NamedSharding
         self.mesh = _resolve_mesh(mesh)
-        self.variables = (
-            shard_params(variables, self.mesh, TRANSFORMER_TP_RULES)
-            if self.mesh is not None else variables
-        )
+        # weight-only int8 serving (docs/PERFORMANCE.md "Quantized
+        # decode"): the device-resident weights are per-channel int8
+        # (min_size=0 — at decode batch sizes EVERY matmul is
+        # bandwidth-bound) and each jitted program dequantizes to bf16
+        # INSIDE jit, so XLA fuses the convert into the consuming
+        # matmul and HBM streams half the bytes per forward. Under a
+        # mesh the quantized pytree is REPLICATED: its {int8, scale}
+        # dict leaves are outside the Megatron path rules, so the
+        # weight-HBM win trades away tensor-parallel weight sharding
+        # (docs/SERVING.md records the trade).
+        self._quantized_weights = bool(quantize_weights)
+        if quantize_weights:
+            from mmlspark_tpu.ops.quantize import (
+                quantize_weights as _quantize_variables,
+            )
+
+            qvars = _quantize_variables(variables, min_size=0)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                qvars = jax.device_put(
+                    qvars, NamedSharding(self.mesh, PartitionSpec())
+                )
+            self.variables = qvars
+        else:
+            self.variables = (
+                shard_params(variables, self.mesh, TRANSFORMER_TP_RULES)
+                if self.mesh is not None else variables
+            )
+        # every jitted program below dequantizes through this hook; the
+        # identity on unquantized engines keeps traces byte-identical
+        # to previous builds
+        if quantize_weights:
+            from mmlspark_tpu.ops.quantize import dequantize_weights
+            _deq = dequantize_weights
+        else:
+            def _deq(v):
+                return v
         # paged KV cache (docs/SERVING.md "Paged KV cache"): the
         # PagedCachePool virtualizes slot memory behind fixed-shape page
         # stores + per-slot page tables — same compiled programs, same
@@ -193,17 +229,18 @@ class ServeEngine:
             )
         self._paged = bool(paged)
         self._prefix_cache = bool(paged and prefix_cache)
+        self.kv_dtype = kv_dtype
         if paged:
             from mmlspark_tpu.serve.paging import PagedCachePool
 
             self.pool = PagedCachePool(
                 graph, variables, slots, cache_len, mesh=self.mesh,
                 page_size=page_size, num_pages=num_pages,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, kv_dtype=kv_dtype,
             )
         else:
             self.pool = SlotCachePool(graph, variables, slots, cache_len,
-                                      mesh=self.mesh)
+                                      mesh=self.mesh, kv_dtype=kv_dtype)
         # replica identity (serve/supervisor.py): tags every fault-hook
         # firing (so replica-pinned kills target THIS engine) and
         # namespaces the registry metric names per replica
@@ -239,6 +276,7 @@ class ServeEngine:
             cache_pool_bytes_per_device=(
                 self.pool.device_bytes_per_device()
             ),
+            kv_dtype=kv_dtype,
             namespace=(
                 f"replica{replica}." if replica is not None else ""
             ),
@@ -327,6 +365,7 @@ class ServeEngine:
             # ``last``, the true prompt end) + a length-B linear cache;
             # jit retraces per distinct BUCKET
             cache = init_cache(graph, variables, 1, prompt.shape[1])
+            variables = _deq(variables)
             logits, cache = _cached_apply(graph, variables, prompt,
                                           cache, 0)
             cur = jax.lax.dynamic_slice_in_dim(
@@ -358,8 +397,8 @@ class ServeEngine:
         # remainder BUCKET alone — the same O(log cache_len) ceiling as
         # full prefill.
         def _resume(variables, ids, cache, pos, last):
-            logits, cache = _cached_apply(graph, variables, ids, cache,
-                                          pos)
+            logits, cache = _cached_apply(graph, _deq(variables), ids,
+                                          cache, pos)
             cur = jax.lax.dynamic_slice_in_dim(
                 logits, last, 1, axis=1
             )[:, 0]
@@ -392,9 +431,20 @@ class ServeEngine:
             jit_kwargs["out_shardings"] = (
                 slot_sh, slot_sh, self.pool.kv_shardings, slot_sh,
             )
+        _raw_block = make_decode_block(graph, pad_id)
+        if self._quantized_weights:
+            # dequantize INSIDE the jitted block (same signature, same
+            # static/donate argnums — the jit contract is untouched);
+            # the int8 weights convert once per dispatch and XLA fuses
+            # the convert into each consuming matmul
+            def _block(variables, buffers, pos, live, tok, rem, eos, t):
+                return _raw_block(_deq(variables), buffers, pos, live,
+                                  tok, rem, eos, t)
+        else:
+            _block = _raw_block
         self._decode = RetraceWatchdog(
             ProgramCountingJit(jax.jit(
-                make_decode_block(graph, pad_id),
+                _block,
                 static_argnums=(7,), donate_argnums=(1, 2, 3),
                 **jit_kwargs,
             )),

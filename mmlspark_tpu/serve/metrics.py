@@ -46,6 +46,7 @@ class ServeMetrics:
                  mesh_shape: dict[str, int] | None = None,
                  mesh_devices: int = 1,
                  cache_pool_bytes_per_device: int = 0,
+                 kv_dtype: str = "bf16",
                  namespace: str = ""):
         self.model = model
         self.slots = slots
@@ -67,6 +68,11 @@ class ServeMetrics:
         self.mesh_shape = dict(mesh_shape or {})
         self.mesh_devices = mesh_devices
         self.cache_pool_bytes_per_device = cache_pool_bytes_per_device
+        #: KV-store dtype of the engine's cache pool ("bf16" or "int8"
+        #: — docs/PERFORMANCE.md "Quantized decode"); paired with
+        #: cache_pool_bytes_per_device so dashboards can attribute a
+        #: bytes drop to quantization rather than a smaller pool
+        self.kv_dtype = kv_dtype
         self.registry = registry if registry is not None else MetricRegistry()
         r = self.registry
 
@@ -456,6 +462,7 @@ class ServeMetrics:
             "mesh_shape": dict(self.mesh_shape),
             "mesh_devices": self.mesh_devices,
             "cache_pool_bytes_per_device": self.cache_pool_bytes_per_device,
+            "kv_dtype": self.kv_dtype,
             # paged KV cache (docs/SERVING.md "Paged KV cache";
             # schema-gated): allocator occupancy, prefix-cache traffic,
             # copy-on-extend count — inert defaults on dense pools

@@ -79,6 +79,11 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.faults import ResourceExhausted
 from mmlspark_tpu.models.generate import cache_geometry
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from mmlspark_tpu.serve.cache_pool import (
+    kv_head_scales,
+    quantize_kv,
+    validate_kv_dtype,
+)
 
 #: smallest page: the TPU sublane tile — a page's (page_size, d) face is
 #: the paged decode kernel's KV block, and blocks under 8 rows cannot
@@ -131,12 +136,22 @@ class PagedCachePool:
     donates and returns the whole pytree unchanged in structure, and
     ``models/transformer.py`` recognizes the 3-tuple as the paged
     cache.
+
+    ``kv_dtype="int8"`` (docs/PERFORMANCE.md "Quantized decode") stores
+    the page faces as int8 — half the bf16 page store's HBM bytes, so a
+    fixed page budget holds 2x the tokens — and each block's entry
+    grows to ``(K, V, PT, k_scale, v_scale)`` with (num_pages, hk) f32
+    PER-PAGE scales as extra cache-pytree leaves: a page's scale is
+    fixed at its FIRST write (prefill slice amax, or the first decode
+    token's amax, + headroom), later writes into the page quantize
+    against it, copy-on-extend copies it with the page, and
+    ``paged_flash_decode`` dequantizes each fetched page in-VMEM.
     """
 
     def __init__(self, graph, variables, slots: int, cache_len: int, *,
                  mesh=None, page_size: int | None = None,
                  num_pages: int | None = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, kv_dtype: str = "bf16"):
         if slots < 1:
             raise FriendlyError(f"slots must be >= 1, got {slots}")
         if cache_len < 2:
@@ -172,6 +187,8 @@ class PagedCachePool:
                 f"({cache_len}): a slot's logical positions tile into "
                 "whole pages"
             )
+        validate_kv_dtype(kv_dtype, geometry)
+        self.kv_dtype = kv_dtype
         self.mesh = mesh
         data = 1
         if mesh is not None:
@@ -211,6 +228,8 @@ class PagedCachePool:
             )
         self.prefix_cache_enabled = bool(prefix_cache)
 
+        quantized = kv_dtype == "int8"
+        store_dtype = jnp.int8 if quantized else jnp.bfloat16
         # -- device-placement anchors (None on a single device) -------
         self._slot_sharding = self._kv_shardings = None
         self._pt_sharding = None
@@ -229,7 +248,15 @@ class PagedCachePool:
                 # below keeps every page a slot maps on the slot's own
                 # shard, so page reads/writes stay shard-local
                 sh = NamedSharding(mesh, P(DATA_AXIS, head, None, None))
-                self._kv_shardings[name] = (sh, sh, self._pt_sharding)
+                if quantized:
+                    # (num_pages, hk) scale leaves shard like the dims
+                    # they index: pages over data, heads over model
+                    ssc = NamedSharding(mesh, P(DATA_AXIS, head))
+                    self._kv_shardings[name] = (
+                        sh, sh, self._pt_sharding, ssc, ssc,
+                    )
+                else:
+                    self._kv_shardings[name] = (sh, sh, self._pt_sharding)
 
         # -- host allocator state --------------------------------------
         # page table mirror: every entry starts at the owning shard's
@@ -269,16 +296,23 @@ class PagedCachePool:
             # K and V must be DISTINCT arrays (the engine donates the
             # pytree; one allocation cannot be donated twice) — and so
             # must each block's page-table copy, which is why PT rides
-            # per block instead of as one shared array
-            k = jnp.zeros((num_pages, hk, page_size, d), jnp.bfloat16)
-            v = jnp.zeros((num_pages, hk, page_size, d), jnp.bfloat16)
+            # per block instead of as one shared array; the int8 mode's
+            # two scale leaves follow the same rule
+            k = jnp.zeros((num_pages, hk, page_size, d), store_dtype)
+            v = jnp.zeros((num_pages, hk, page_size, d), store_dtype)
             pt = jnp.asarray(self._pt_host)
+            entry = (k, v, pt)
+            if quantized:
+                entry = (
+                    k, v, pt,
+                    jnp.ones((num_pages, hk), jnp.float32),
+                    jnp.ones((num_pages, hk), jnp.float32),
+                )
             if self._kv_shardings is not None:
-                sk, sv, sp = self._kv_shardings[name]
-                k = jax.device_put(k, sk)
-                v = jax.device_put(v, sv)
-                pt = jax.device_put(pt, sp)
-            self.buffers[name] = (k, v, pt)
+                entry = tuple(jax.device_put(
+                    entry, self._kv_shardings[name]
+                ))
+            self.buffers[name] = entry
         self._free = list(range(slots - 1, -1, -1))
         self._leased: set[int] = set()
         self.positions = self._commit_slot(jnp.zeros((slots,), jnp.int32))
@@ -406,10 +440,18 @@ class PagedCachePool:
         return changed_kv
 
     def _copy_page(self, src: int, dst: int) -> None:
-        for name, (pk, pv, pt) in self.buffers.items():
+        for name, (pk, pv, pt, *scales) in self.buffers.items():
             nk = pk.at[dst].set(pk[src])
             nv = pv.at[dst].set(pv[src])
-            self.buffers[name] = (nk, nv, pt)
+            if scales:
+                # int8 mode: a page copy is only faithful WITH its
+                # quantization scales — the copied int8 values decode
+                # through the same multipliers as the original's
+                ks, vs = scales
+                scales = [
+                    ks.at[dst].set(ks[src]), vs.at[dst].set(vs[src]),
+                ]
+            self.buffers[name] = (nk, nv, pt, *scales)
 
     # -- device-state commits ----------------------------------------------
 
@@ -419,11 +461,11 @@ class PagedCachePool:
         committed to the table's canonical sharding under a mesh."""
         if not self._pt_dirty:
             return
-        for name, (pk, pv, _old) in self.buffers.items():
+        for name, (pk, pv, _old, *scales) in self.buffers.items():
             pt = jnp.asarray(self._pt_host)
             if self._kv_shardings is not None:
                 pt = jax.device_put(pt, self._kv_shardings[name][2])
-            self.buffers[name] = (pk, pv, pt)
+            self.buffers[name] = (pk, pv, pt, *scales)
         self._pt_dirty = False
 
     def _commit_kv(self) -> None:
@@ -434,11 +476,19 @@ class PagedCachePool:
         batched update contract."""
         if self._kv_shardings is None:
             return
-        kv = {name: (k, v) for name, (k, v, _pt) in self.buffers.items()}
-        sh = {name: (s[0], s[1]) for name, s in self._kv_shardings.items()}
+        # int8 mode: the (num_pages, hk) scale leaves ride the same
+        # commit — eager page copies touch them too, and their pinned
+        # shardings sit at the same tuple positions in _kv_shardings
+        kv = {
+            name: (e[0], e[1], *e[3:]) for name, e in self.buffers.items()
+        }
+        sh = {
+            name: (s[0], s[1], *s[3:])
+            for name, s in self._kv_shardings.items()
+        }
         kv = jax.device_put(kv, sh)
-        for name, (k, v) in kv.items():
-            self.buffers[name] = (k, v, self.buffers[name][2])
+        for name, (k, v, *scales) in kv.items():
+            self.buffers[name] = (k, v, self.buffers[name][2], *scales)
 
     def _commit_slot_pair(self, positions, live) -> None:
         """Rebind positions+live behind ONE pinned update (two
@@ -546,16 +596,54 @@ class PagedCachePool:
         pos = np.arange(start, length)
         pages = jnp.asarray(self._pt_host[slot, pos // self.page_size])
         offs = jnp.asarray(pos % self.page_size)
-        for name, (pk, pv, pt) in self.buffers.items():
+        quantized = self.kv_dtype == "int8"
+        for name, (pk, pv, pt, *scales) in self.buffers.items():
             ck, cv = prefill_cache[name][0], prefill_cache[name][1]
             hidx = jnp.arange(pk.shape[1])
-            nk = pk.at[pages[:, None], hidx[None, :], offs[:, None]].set(
-                ck[0, start:length].astype(pk.dtype)
-            )
-            nv = pv.at[pages[:, None], hidx[None, :], offs[:, None]].set(
-                cv[0, start:length].astype(pv.dtype)
-            )
-            self.buffers[name] = (nk, nv, pt)
+            if quantized:
+                ks, vs = scales
+                # Per-page scales are fixed at each page's FIRST write:
+                # a page is fresh here iff its first logical position
+                # is at or past ``start`` — the prefix-resume path's
+                # shared partial page keeps its registered scale (its
+                # already-written half dequantizes through that
+                # multiplier; re-deriving one would corrupt it), and
+                # the remainder saturates into the budget instead.
+                first_pg = start // self.page_size
+                last_pg = (length - 1) // self.page_size
+                k_rows, v_rows = [], []
+                for pg in range(first_pg, last_pg + 1):
+                    lo = max(pg * self.page_size, start)
+                    hi = min((pg + 1) * self.page_size, length)
+                    sk = ck[0, lo:hi].astype(jnp.float32)
+                    sv = cv[0, lo:hi].astype(jnp.float32)
+                    page = int(self._pt_host[slot, pg])
+                    if pg * self.page_size >= start:
+                        pks = kv_head_scales(sk, axes=(0, 2))
+                        pvs = kv_head_scales(sv, axes=(0, 2))
+                        ks = ks.at[page].set(pks)
+                        vs = vs.at[page].set(pvs)
+                    else:
+                        pks, pvs = ks[page], vs[page]
+                    k_rows.append(quantize_kv(sk, pks))
+                    v_rows.append(quantize_kv(sv, pvs))
+                qk = jnp.concatenate(k_rows, axis=0)
+                qv = jnp.concatenate(v_rows, axis=0)
+                nk = pk.at[
+                    pages[:, None], hidx[None, :], offs[:, None]
+                ].set(qk)
+                nv = pv.at[
+                    pages[:, None], hidx[None, :], offs[:, None]
+                ].set(qv)
+                self.buffers[name] = (nk, nv, pt, ks, vs)
+            else:
+                nk = pk.at[
+                    pages[:, None], hidx[None, :], offs[:, None]
+                ].set(ck[0, start:length].astype(pk.dtype))
+                nv = pv.at[
+                    pages[:, None], hidx[None, :], offs[:, None]
+                ].set(cv[0, start:length].astype(pv.dtype))
+                self.buffers[name] = (nk, nv, pt)
         self._commit_kv()
         self._commit_pt()
         self._commit_slot_pair(
@@ -689,14 +777,22 @@ class PagedCachePool:
 
             rep = NamedSharding(self.mesh, P())
         out = {}
-        for name, (pk, pv, _pt) in self.buffers.items():
+        for name, (pk, pv, _pt, *scales) in self.buffers.items():
             hk, d = pk.shape[1], pk.shape[3]
             lin = []
-            for store in (pk, pv):
-                g = jnp.swapaxes(store[idx], 1, 2)  # (n, ps, hk, d)
+            for store, scl in zip((pk, pv), scales or (None, None)):
+                g = store[idx]  # (n, hk, ps, d)
+                dtype = store.dtype
+                if scl is not None:
+                    # int8 pages dequantize through their per-page
+                    # scales into the bf16 linear cache the resume
+                    # program expects (it re-quantizes on write-back)
+                    g = g.astype(jnp.float32) * scl[idx][:, :, None, None]
+                    dtype = jnp.bfloat16
+                g = jnp.swapaxes(g, 1, 2)  # (n, ps, hk, d)
                 g = g.reshape(n * self.page_size, hk, d)[:keep]
-                arr = jnp.zeros((1, self.cache_len, hk, d), store.dtype)
-                arr = arr.at[0, :keep].set(g)
+                arr = jnp.zeros((1, self.cache_len, hk, d), dtype)
+                arr = arr.at[0, :keep].set(g.astype(dtype))
                 if rep is not None:
                     arr = jax.device_put(arr, rep)
                 lin.append(arr)
@@ -767,6 +863,7 @@ class PagedCachePool:
         it makes a crash dump auditable: refcount totals must equal
         mapped-page counts, which the round-trip test asserts."""
         return {
+            "kv_dtype": self.kv_dtype,
             "page_size": int(self.page_size),
             "num_pages": int(self.num_pages),
             "max_pages": int(self.max_pages),

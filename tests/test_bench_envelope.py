@@ -94,7 +94,7 @@ def test_scratch_merge_roundtrip_and_missing_groups(monkeypatch, tmp_path):
     assert set(line["missing_metrics"]) == {
         "stage", "resnet50", "train", "trees", "flash", "flash_long",
         "int8_serving", "feed_synth", "decode", "serve", "serve_paged",
-        "serve_sharded", "serve_faults", "serve_supervisor",
+        "serve_int8", "serve_sharded", "serve_faults", "serve_supervisor",
     }
     # merge is a real file round-trip: a fresh load sees the update
     with open(os.environ["MMLTPU_BENCH_SCRATCH"], encoding="utf-8") as f:

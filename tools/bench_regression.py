@@ -14,6 +14,15 @@ figures, all higher-is-better. Latency figures are deliberately out of
 scope: their distributions on shared CI hosts are too heavy-tailed for
 a tolerance band to mean anything.
 
+Besides the throughput band, the gate enforces EMBEDDED BUDGETS: any
+dict in the fresh doc carrying a numeric ``<name>`` next to a numeric
+``<name>_budget`` (the ``serve_int8`` group's ``token_flip_rate`` /
+``token_flip_budget`` and ``max_abs_err`` / ``max_abs_err_budget``
+pairs, docs/PERFORMANCE.md "Quantized decode") fails the gate when the
+measured value exceeds its budget — lower-is-better by construction,
+no history needed, so an accuracy breach is red even on the first run
+of a new metric.
+
 History entries come in two shapes, both handled:
 
 - direct bench dicts (``BENCH_FULL.json``, ``BENCH_LOCAL_r4.json`` —
@@ -72,6 +81,41 @@ def throughput_leaves(doc, path: tuple = ()) -> dict[str, float]:
         dotted = ".".join(path)
         if "per_sec" in dotted and doc > 0:
             out[dotted] = float(doc)
+    return out
+
+
+def budget_violations(doc, path: tuple = ()) -> list[str]:
+    """Breached ``<name>`` / ``<name>_budget`` pairs anywhere in the
+    doc, as report lines. A measured value AT the budget passes — the
+    budget is the allowed ceiling, not an open bound."""
+    out: list[str] = []
+    if not isinstance(doc, dict):
+        return out
+    for key, value in doc.items():
+        if isinstance(value, dict):
+            out.extend(budget_violations(value, path + (str(key),)))
+            continue
+        if not str(key).endswith("_budget"):
+            continue
+        stem = str(key)[: -len("_budget")]
+        # "max_abs_err" pairs with "max_abs_err_budget";
+        # "token_flip_rate" pairs with "token_flip_budget"
+        name = next(
+            (n for n in (stem, stem + "_rate") if n in doc), stem
+        )
+        measured = doc.get(name)
+        if (
+            isinstance(measured, (int, float))
+            and isinstance(value, (int, float))
+            and not isinstance(measured, bool)
+            and not isinstance(value, bool)
+            and measured > value
+        ):
+            dotted = ".".join(path + (name,))
+            out.append(
+                f"{dotted}: measured {measured} exceeds its embedded "
+                f"budget {value}"
+            )
     return out
 
 
@@ -159,8 +203,21 @@ def run_gate(fresh_path: str, pattern: str, tolerance: float) -> int:
               f"{fresh_path}: {e}", file=sys.stderr)
         return 1
     fresh: dict[str, float] = {}
+    breaches: list[str] = []
     for payload in unwrap(doc):
         fresh.update(throughput_leaves(payload))
+        breaches.extend(budget_violations(payload))
+    # budget breaches are absolute — they fail BEFORE (and regardless
+    # of) whether any throughput history exists to band against
+    for line in breaches:
+        print(f"bench_regression: FAIL {line}", file=sys.stderr)
+    if breaches:
+        print(
+            f"bench_regression: FAIL — {len(breaches)} embedded accuracy "
+            f"budget breach(es) in {os.path.basename(fresh_path)}",
+            file=sys.stderr,
+        )
+        return 1
     history, used = load_history(pattern)
     if not fresh or not set(fresh) & set(history):
         print(
@@ -207,7 +264,8 @@ def _scale_leaves(doc, factor: float, path: tuple = ()):
 def run_selftest(pattern: str, tolerance: float) -> int:
     """Prove the gate on the real history: the newest usable entry must
     pass against the full history; the same entry with a 25% injected
-    slowdown must fail."""
+    slowdown must fail; a synthesized doc with a breached embedded
+    accuracy budget must fail even with no comparable history."""
     import tempfile
 
     history, used = load_history(pattern)
@@ -224,11 +282,28 @@ def run_selftest(pattern: str, tolerance: float) -> int:
     with tempfile.TemporaryDirectory() as tdir:
         clean = os.path.join(tdir, "fresh.json")
         slow = os.path.join(tdir, "slow.json")
+        breach = os.path.join(tdir, "breach.json")
         json.dump(doc, open(clean, "w", encoding="utf-8"))
         json.dump(_scale_leaves(doc, 0.75), open(slow, "w",
                                                  encoding="utf-8"))
+        # the serve_int8 shape with its flip budget breached — proves
+        # the accuracy gate trips with zero throughput history in play
+        json.dump(
+            {"serve_int8": {"token_flip_rate": 0.5,
+                            "token_flip_budget": 0.25,
+                            "max_abs_err": 0.01,
+                            "max_abs_err_budget": 0.0625}},
+            open(breach, "w", encoding="utf-8"),
+        )
         rc_clean = run_gate(clean, pattern, tolerance)
         rc_slow = run_gate(slow, pattern, tolerance)
+        rc_breach = run_gate(breach, pattern, tolerance)
+    if rc_breach == 0:
+        print(
+            "bench_regression: SELFTEST FAIL — a breached embedded "
+            "accuracy budget was NOT caught", file=sys.stderr,
+        )
+        return 1
     if rc_clean != 0:
         print(
             "bench_regression: SELFTEST FAIL — the newest usable "
@@ -244,7 +319,7 @@ def run_selftest(pattern: str, tolerance: float) -> int:
         return 1
     print(
         "bench_regression: SELFTEST OK — clean history passes, a 25% "
-        "injected slowdown fails "
+        "injected slowdown fails, a breached accuracy budget fails "
         f"(tolerance {tolerance:.0%}, history: {', '.join(used)})"
     )
     return 0

@@ -92,6 +92,10 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "mesh_shape": (dict,),
     "mesh_devices": (int,),
     "cache_pool_bytes_per_device": (int,),
+    # quantized decode (docs/PERFORMANCE.md "Quantized decode"): the
+    # pool's KV store dtype — "bf16" or "int8" — always present so
+    # dashboards can attribute cache_pool_bytes_per_device deltas
+    "kv_dtype": (str,),
     # resilience plane (docs/SERVING.md "Failure semantics"): terminal
     # statuses beyond completed/expired plus the fault-handling
     # counters — always present (0 on a fault-free run) so dashboards
@@ -411,6 +415,63 @@ def check_replica_mode(env: dict, repo: str) -> None:
             )
 
 
+def check_int8_mode(env: dict, repo: str) -> None:
+    """Third smoke pass: the same demo config at ``--kv-dtype bf16``
+    and ``--kv-dtype int8`` (+ ``--quantize-weights``). Pins the
+    quantized-decode surface (docs/PERFORMANCE.md "Quantized decode"):
+    the JSON line reports the configured kv_dtype, the int8 pool's
+    per-device KV bytes land strictly below the bf16 pool's, and the
+    run still completes every request."""
+    def one(kv_dtype: str) -> dict:
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu",
+            "serve", "--demo", "--slots", "2",
+            "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
+            "--kv-dtype", kv_dtype,
+        ]
+        if kv_dtype == "int8":
+            cmd.append("--quantize-weights")
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env=env, cwd=repo,
+        )
+        if res.returncode != 0:
+            fail(f"serve --demo --kv-dtype {kv_dtype} exited "
+                 f"{res.returncode}:\n{res.stderr}")
+        out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+        if len(out_lines) != 1:
+            fail(
+                f"--kv-dtype {kv_dtype} stdout must be exactly ONE "
+                f"JSON line, got {len(out_lines)}:\n{res.stdout}"
+            )
+        try:
+            md = json.loads(out_lines[0])
+        except json.JSONDecodeError as e:
+            fail(f"--kv-dtype {kv_dtype} stdout line is not JSON: {e}")
+        check_metrics_dict(md, f"--kv-dtype {kv_dtype} stdout")
+        if md.get("kv_dtype") != kv_dtype:
+            fail(
+                f"a --kv-dtype {kv_dtype} run must report kv_dtype == "
+                f"{kv_dtype!r}, got {md.get('kv_dtype')!r}"
+            )
+        if md.get("completed") != N_REQUESTS:
+            fail(
+                f"--kv-dtype {kv_dtype} run must complete all "
+                f"{N_REQUESTS} requests, got {md.get('completed')}"
+            )
+        return md
+    bf16 = one("bf16")
+    int8 = one("int8")
+    b_bytes = bf16["cache_pool_bytes_per_device"]
+    q_bytes = int8["cache_pool_bytes_per_device"]
+    if not q_bytes < b_bytes:
+        fail(
+            f"the int8 pool must hold fewer per-device KV bytes than "
+            f"the bf16 pool at the same geometry, got int8={q_bytes} "
+            f"vs bf16={b_bytes}"
+        )
+
+
 def main() -> None:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -503,13 +564,15 @@ def main() -> None:
             if needle not in prom:
                 fail(f"metrics.prom lacks {needle!r}")
     check_replica_mode(env, repo)
+    check_int8_mode(env, repo)
     print(
         f"check_metrics_schema: OK — {len(REQUIRED_METRIC_KEYS)} metric "
         f"keys on both surfaces, {N_REQUESTS} complete request spans "
         f"across {n_events} events, {n_trace} trace events, prom "
         f"exposition present; --replicas 2 line carries "
         f"{len(REQUIRED_REPLICA_KEYS)} control-plane keys + "
-        f"{len(REQUIRED_PER_REPLICA_KEYS)} per-replica keys"
+        f"{len(REQUIRED_PER_REPLICA_KEYS)} per-replica keys; int8 pool "
+        f"reports fewer per-device KV bytes than bf16"
     )
 
 

@@ -99,6 +99,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "supervisor gate (replica failover / hedging / drain chaos drills)"
   python -m pytest tests/test_serve_supervisor.py -q
 
+  step "quantized decode gate (int8 KV + weight-only int8 vs the bf16 oracle)"
+  python -m pytest tests/test_quantized_serve.py -q
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
