@@ -122,6 +122,7 @@ _GROUPS = {
     "serve_paged": ("serve_paged",),
     "serve_int8": ("serve_int8",),
     "serve_supervisor": ("serve_supervisor",),
+    "serve_disagg": ("serve_disagg",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -1338,6 +1339,146 @@ def bench_serve_supervisor(jax) -> dict:
     return {"serve_supervisor": out}
 
 
+def bench_serve_disagg(jax) -> dict:
+    """Disaggregated-fleet figures (docs/SERVING.md "Disaggregated
+    fleet"), at EQUAL device count vs the homogeneous baseline:
+
+    - ``ttft_p99_ms_disagg`` vs ``ttft_p99_ms_homogeneous``: the SAME
+      bursty open-loop arrival schedule through a 1-prefill +
+      1-decode ``DisaggFleet`` and a 2-replica ``ReplicaSet``. In the
+      homogeneous set a burst of joiners competes with decode for the
+      same replica's ticks; with a dedicated prefill replica the burst
+      never queues behind decode blocks — the figure prices exactly
+      that (bench_regression gates the acceptance bound: disagg TTFT
+      p99 no worse than homogeneous);
+    - ``tokens_per_sec_disagg``: fleet throughput on the burst (the
+      regression-gated ``per_sec`` leaf for this group);
+    - ``prefix_reuse``: the same prompt re-submitted across the fleet —
+      hand-offs seed the fleet-wide prefix index, so repeats skip
+      prefill entirely (``prefill_tokens_saved``, prefill-once-per-
+      FLEET) and land decode-only on any replica."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve import DisaggFleet, ReplicaSet
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 32, 2, 2)
+    )
+    slots, n_req, max_new = (8, 16, 33) if full else (4, 8, 9)
+    p = 8
+    cache_len = 128 if full else 32
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len,
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, p), jnp.int32)
+    )
+    prompts = [
+        row.astype(np.int32)
+        for row in np.random.default_rng(17).integers(
+            0, vocab, size=(n_req, p)
+        )
+    ]
+    # decode_block=8: long fused decode ticks are the contention that
+    # disaggregation removes — in the homogeneous set a joiner waits
+    # behind a full decode block before admission, while the dedicated
+    # prefill replica's ticks stay prefill-only
+    kwargs = dict(slots=slots, cache_len=cache_len, max_queue=n_req,
+                  decode_block=8, retry_backoff_s=0.0)
+    burst = max(2, n_req // 4)
+    repeats = 5
+
+    def drive_bursty(target) -> dict:
+        """Open-loop: a burst of joiners every other tick, regardless
+        of completions — arrivals do not wait for capacity."""
+        it = iter(prompts)
+        pending = True
+        tick = 0
+        while pending or target.busy:
+            if tick % 2 == 0:
+                for _ in range(burst):
+                    pr = next(it, None)
+                    if pr is None:
+                        pending = False
+                        break
+                    target.submit(pr, max_new_tokens=max_new)
+            target.step()
+            tick += 1
+        return target.run()
+
+    # prefix_index_capacity=0: the timed pass re-drives the same
+    # prompts, and an index hit would report route time as TTFT —
+    # this figure must price the PREFILL -> HAND-OFF path
+    fleet = DisaggFleet(graph, variables, prefill_replicas=1,
+                        decode_replicas=1, prefix_index_capacity=0,
+                        **kwargs)
+    rs = ReplicaSet(graph, variables, replicas=2, **kwargs)
+    drive_bursty(fleet)  # warm-up: compiles both role ladders
+    drive_bursty(rs)
+    # p99 over ONE schedule is the max of n_req samples — a single
+    # scheduler blip decides it — so pool several timed repeats, and
+    # INTERLEAVE the two targets so host drift (GC, clock ramp) lands
+    # on both sides of the ratio equally. Replica 0 is the (only)
+    # prefill replica: its first-token histogram IS the fleet's
+    # hand-off TTFT (the engine stamps first tokens at admission).
+    f_ttfts, r_ttfts = [], []
+    f_secs = r_secs = 0.0
+    for _ in range(repeats):
+        t0 = len(fleet.engine(0).metrics.ttft_s)
+        f_secs += _timed(lambda: drive_bursty(fleet))
+        f_ttfts += [
+            t * 1e3 for t in fleet.engine(0).metrics.ttft_s[t0:]
+        ]
+        before = [len(rs.engine(i).metrics.ttft_s) for i in range(2)]
+        r_secs += _timed(lambda: drive_bursty(rs))
+        for i in range(2):
+            r_ttfts += [
+                t * 1e3
+                for t in rs.engine(i).metrics.ttft_s[before[i]:]
+            ]
+    ttft_disagg = float(np.percentile(f_ttfts, 99))
+    ttft_homog = float(np.percentile(r_ttfts, 99))
+    tps_disagg = repeats * n_req * max_new / f_secs
+    tps_homog = repeats * n_req * max_new / r_secs
+
+    # prefix-once-per-fleet, on a separate index-enabled fleet: the
+    # first drive hands every prompt off and indexes it fleet-wide;
+    # re-driving the same schedule is then prefill-free
+    ifleet = DisaggFleet(graph, variables, prefill_replicas=1,
+                         decode_replicas=1, **kwargs)
+    drive_bursty(ifleet)
+    pre_submitted = ifleet.engine(0).metrics.submitted
+    drive_bursty(ifleet)
+    reuse = {
+        "prefix_hits": ifleet.fleet_prefix_hits_total,
+        "prefill_tokens_saved":
+            ifleet.fleet_prefill_tokens_saved_total,
+        "prefill_requests_avoided":
+            n_req - (ifleet.engine(0).metrics.submitted - pre_submitted),
+    }
+
+    out: dict = {
+        "ttft_p99_ms_disagg": round(ttft_disagg, 2),
+        "ttft_p99_ms_homogeneous": round(ttft_homog, 2),
+        "ttft_p99_ratio": round(ttft_disagg / ttft_homog, 3)
+        if ttft_homog > 0 else None,
+        "tokens_per_sec_disagg": round(tps_disagg, 1),
+        "tokens_per_sec_homogeneous": round(tps_homog, 1),
+        "handoffs_total": fleet.handoffs_total + ifleet.handoffs_total,
+        "prefix_reuse": reuse,
+        "model": {"vocab": vocab, "d_model": d_model, "heads": heads,
+                  "depth": depth, "requests": n_req, "prompt": p,
+                  "max_new": max_new, "slots": slots, "burst": burst},
+        "timing": ("bursty open-loop drive per target, warm-up then "
+                   "one timed pass; both targets at equal device "
+                   "count (2 engines)"),
+    }
+    return {"serve_disagg": out}
+
+
 def bench_serve_sharded() -> dict:
     """Mesh-sharded serving scaling sweep (docs/SERVING.md "Sharded
     serving"): the SAME synthetic-traffic demo as the ``serve`` group,
@@ -1838,6 +1979,7 @@ def run(attempt: int) -> dict:
         "serve_paged": lambda: bench_serve_paged(jax),
         "serve_int8": lambda: bench_serve_int8(jax),
         "serve_supervisor": lambda: bench_serve_supervisor(jax),
+        "serve_disagg": lambda: bench_serve_disagg(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),

@@ -89,6 +89,10 @@ def cmd_serve(args) -> int:
         hedge_ms=args.hedge_ms,
         kv_dtype=args.kv_dtype,
         quantize_weights=args.quantize_weights,
+        disagg=args.disagg,
+        prefill_replicas=args.prefill_replicas,
+        decode_replicas=args.decode_replicas,
+        autoscale=args.autoscale or None,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -306,6 +310,33 @@ def main(argv: list[str] | None = None) -> int:
         "replica once it has waited X ms (tail-latency hedging, "
         "first-committed-wins; the loser cancels and its tokens count "
         "as hedge_wasted_tokens_total)",
+    )
+    sp.add_argument(
+        "--disagg", action="store_true",
+        help="serve through a DisaggFleet of dedicated prefill and "
+        "decode replicas: prefill replicas hand each request's KV + "
+        "first token to decode replicas over the cross-replica "
+        "hand-off plane, and a fleet-wide prefix index makes repeat "
+        "prompts prefill-free fleet-wide; the JSON line becomes the "
+        "fleet's metrics (handoffs_total, fleet_prefix_hits_total, "
+        "scale_ups_total, per_role, per_replica) "
+        "(docs/SERVING.md 'Disaggregated fleet')",
+    )
+    sp.add_argument(
+        "--prefill-replicas", type=int, default=1, metavar="N",
+        help="with --disagg: dedicated prefill replicas (default 1)",
+    )
+    sp.add_argument(
+        "--decode-replicas", type=int, default=1, metavar="N",
+        help="with --disagg: dedicated decode replicas (default 1)",
+    )
+    sp.add_argument(
+        "--autoscale", default="", metavar="SPEC",
+        help="with --disagg: elastic per-role scaling policy as "
+        "key=value pairs, e.g. 'max_decode=4,queue_high=2,"
+        "slo_burn_ticks=3,idle_ticks=8' — scale-up draws from the "
+        "parked budget (max minus baseline), scale-down drains idle "
+        "replicas back to it (docs/SERVING.md 'Disaggregated fleet')",
     )
     sp.set_defaults(fn=cmd_serve)
 

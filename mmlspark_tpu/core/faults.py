@@ -69,9 +69,13 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 #: is not restorable). ``serve.health`` fires in the supervisor's
 #: per-replica probe — a fault there is a failed health check and
 #: quarantines + fails over the replica (serve/supervisor.py).
+#: ``serve.handoff`` fires when a decode-role engine adopts a
+#: cross-replica KV hand-off payload (serve/fleet.py): a fault there
+#: models a lost/corrupt hand-off, and the engine falls back to a full
+#: local prefill so the request still completes bit-identically.
 SITES = (
     "serve.prefill", "serve.decode", "serve.device_get",
-    "serve.snapshot", "serve.health",
+    "serve.snapshot", "serve.health", "serve.handoff",
 )
 #: fault kinds fire() raises/sleeps for, in rate-table draw order
 FIRE_KINDS = ("transient", "oom", "stall", "kill")

@@ -528,6 +528,11 @@ class SloMonitor:
         self._finish: deque[tuple[float, bool]] = deque()
         self.should_shed = False
         self.violations_total = 0
+        #: CONSECUTIVE burning evaluations (reset on recovery) — the
+        #: fleet autoscaler's scale-up signal (serve/fleet.py): a
+        #: single bad window hedges noise, a streak means the current
+        #: replica count cannot meet the declared targets
+        self.burn_ticks = 0
         self._burning = (
             registry.gauge("slo.burning") if registry is not None else None
         )
@@ -630,6 +635,7 @@ class SloMonitor:
                 self._recorder.record("slo_recovered", tick=tick)
             _log.info("SLO recovered, admissions resume")
         self.should_shed = burning
+        self.burn_ticks = self.burn_ticks + 1 if burning else 0
         if self._burning is not None:
             self._burning.set(int(burning))
 
@@ -645,6 +651,7 @@ class SloMonitor:
                 "finish_samples": len(self._finish),
             },
             "burning": burning,
+            "burn_ticks": self.burn_ticks,
             "violations": violations,
             "violations_total": self.violations_total,
         }
@@ -657,6 +664,7 @@ class SloMonitor:
             "targets": self.targets.to_dict(),
             "window": {},
             "burning": False,
+            "burn_ticks": 0,
             "violations": [],
             "violations_total": 0,
         }
@@ -675,8 +683,9 @@ _TID_DISPATCH = 1
 _TID_EVENTS = 2
 
 #: terminal span statuses (the exporter closes a request slice on the
-#: first of these it sees)
-_TERMINAL = ("completed", "expired", "failed", "stalled")
+#: first of these it sees); ``handed_off`` is terminal on a
+#: prefill-role engine — the request continues on a decode replica
+_TERMINAL = ("completed", "expired", "failed", "stalled", "handed_off")
 
 
 def export_chrome_trace(recorder, *, path: str | None = None,

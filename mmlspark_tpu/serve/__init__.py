@@ -21,6 +21,15 @@ For replicated serving, :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet`
 (docs/SERVING.md "Replicated serving") puts N engines behind one
 ``submit()/run()`` facade with health probes, snapshot-based failover,
 hedged routing, and zero-loss drain.
+
+For DISAGGREGATED serving, :class:`~mmlspark_tpu.serve.fleet.DisaggFleet`
+(docs/SERVING.md "Disaggregated fleet") splits the replicas into
+dedicated prefill and decode roles behind the same facade: prefill
+replicas ship each request's KV + first token to decode replicas over
+a cross-replica hand-off plane (the ``serve.handoff`` fault site), a
+fleet-wide prefix index makes any replica's completed prefill every
+replica's cache hit, and an :class:`~mmlspark_tpu.serve.fleet.AutoscalePolicy`
+grows/shrinks each role elastically from a parked device budget.
 """
 
 from mmlspark_tpu.core.faults import (  # noqa: F401
@@ -37,6 +46,11 @@ from mmlspark_tpu.core.perf import (  # noqa: F401
 )
 from mmlspark_tpu.serve.cache_pool import SlotCachePool  # noqa: F401
 from mmlspark_tpu.serve.engine import ServeEngine  # noqa: F401
+from mmlspark_tpu.serve.fleet import (  # noqa: F401
+    AutoscalePolicy,
+    DisaggFleet,
+    parse_autoscale_spec,
+)
 from mmlspark_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from mmlspark_tpu.serve.scheduler import (  # noqa: F401
     ContinuousBatchScheduler,

@@ -41,13 +41,21 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              replicas: int = 1,
              hedge_ms: float | None = None,
              kv_dtype: str = "bf16",
-             quantize_weights: bool = False) -> dict:
+             quantize_weights: bool = False,
+             disagg: bool = False,
+             prefill_replicas: int = 1,
+             decode_replicas: int = 1,
+             autoscale: str | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line. With ``replicas > 1`` the loop drives
     a :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet` instead of a
     single engine (docs/SERVING.md "Replicated serving") and the JSON
     line is the supervisor's ``metrics_dict`` — control-plane totals
-    plus one nested dict per replica."""
+    plus one nested dict per replica. With ``disagg`` it drives a
+    :class:`~mmlspark_tpu.serve.fleet.DisaggFleet` of dedicated
+    prefill/decode replicas (docs/SERVING.md "Disaggregated fleet");
+    ``autoscale`` takes the ``"max_decode=4,queue_high=2"``-style
+    policy spec."""
     import jax
     import jax.numpy as jnp
 
@@ -86,7 +94,15 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
     # chaos injection (docs/OBSERVABILITY.md "Fault injection");
     # None = no injector, hooks cost one attribute check
     injector = parse_fault_spec(faults) if faults else None
-    if replicas > 1:
+    if disagg:
+        from mmlspark_tpu.serve.fleet import DisaggFleet
+
+        target = DisaggFleet(
+            graph, variables, prefill_replicas=prefill_replicas,
+            decode_replicas=decode_replicas, autoscale=autoscale or None,
+            faults=injector, **engine_kwargs,
+        )
+    elif replicas > 1:
         from mmlspark_tpu.serve.supervisor import ReplicaSet
 
         target = ReplicaSet(
@@ -115,7 +131,7 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         for res in target.step():
             results[res.id] = res
 
-    if replicas > 1:
+    if disagg or replicas > 1:
         out = target.metrics_dict()
         recorder = target.recorder
         registry = target.registry

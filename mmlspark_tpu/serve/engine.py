@@ -123,7 +123,8 @@ class ServeEngine:
                  replica: int | None = None,
                  snapshot_every_ticks: int | None = None,
                  kv_dtype: str = "bf16",
-                 quantize_weights: bool = False):
+                 quantize_weights: bool = False,
+                 role: str = "both"):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -249,6 +250,25 @@ class ServeEngine:
                 f"replica index must be >= 0, got {replica}"
             )
         self._replica = replica
+        # disaggregated-fleet role (docs/SERVING.md "Disaggregated
+        # fleet"): "prefill" engines run admission + prefill only and
+        # retire each request as "handed_off" with its KV payload in
+        # the outbox; "decode" engines adopt those payloads by direct
+        # KV write (and keep FULL prefill capability — the fallback
+        # when a hand-off is lost keeps streams bit-identical);
+        # "both" (the default) is the classic homogeneous engine.
+        if role not in ("both", "prefill", "decode"):
+            raise FriendlyError(
+                f"role must be 'both', 'prefill' or 'decode', got "
+                f"{role!r}"
+            )
+        self.role = role
+        #: KV hand-off payloads awaiting collection by the fleet
+        #: (prefill-role engines fill this; ``take_handoffs`` drains)
+        self._outbox: list[dict] = []
+        #: engine-local request id -> pending hand-off payload, popped
+        #: by the admit loop for the direct-KV-write adoption path
+        self._handoffs: dict[int, dict] = {}
         # periodic snapshot cadence: every N ticks, step() refreshes
         # ``last_snapshot`` through the serve.snapshot fault hook — the
         # supervisor's recovery point. None (the default) keeps
@@ -815,6 +835,57 @@ class ServeEngine:
                 )
                 first = None
                 attempts = 0
+                # cross-replica KV hand-off adoption (serve/fleet.py):
+                # the payload's cache is another replica's prefill
+                # program output for this EXACT sequence, so a direct
+                # write into the leased slot is bit-identical to
+                # running prefill here — no forward pass, no XLA
+                # program. The write travels the ``serve.handoff``
+                # fault hook; a payload that cannot land falls back to
+                # the full local prefill below (greedy determinism
+                # keeps the resulting stream bit-identical).
+                payload = self._handoffs.pop(req.id, None)
+                adopted = False
+                if payload is not None:
+                    with annotate("serve.handoff"):
+                        p = len(seq)
+                        bucket = self.prefill_bucket(p)
+                        cache = payload["kv"]
+                        tp = time.perf_counter()
+                        while True:
+                            try:
+                                if self._faults is not None:
+                                    self._faults.fire(
+                                        "serve.handoff", tick=tick,
+                                        request=req.id,
+                                        replica=self._replica,
+                                    )
+                                self.pool.write_prefill(slot, cache, p)
+                                if self._prefix_cache:
+                                    self.pool.prefix_insert(slot, seq)
+                                first = int(payload["first_token"])
+                                adopted = True
+                                break
+                            except Exception as e:
+                                if is_resource_exhausted(e):
+                                    self._note_oom(tick,
+                                                   "serve.handoff")
+                                elif not is_transient(e):
+                                    raise
+                                attempts += 1
+                                if attempts > self._retry_limit:
+                                    break
+                                self._backoff(attempts)
+                    if not adopted:
+                        # lost/undeliverable hand-off: the request
+                        # stays, the payload is discarded, and the
+                        # full-prefill path below rebuilds the same
+                        # KV from the prompt (attempts carry over
+                        # into its retry budget)
+                        self.metrics.record_handoff_fallback()
+                        self.recorder.record(
+                            "handoff_fallback", tick=tick, id=req.id,
+                        )
                 # prefix-cache probe: a hit swaps the full-prompt
                 # prefill for a REMAINDER resume against the cached
                 # prefix's pages (shared, refcounted — the prefix
@@ -823,7 +894,7 @@ class ServeEngine:
                     self.pool.prefix_lookup(
                         seq, self.prefill_bucket, slot=slot
                     )
-                    if self._prefix_cache else None
+                    if self._prefix_cache and not adopted else None
                 )
                 keep = 0
                 with annotate("serve.prefill"):
@@ -896,10 +967,11 @@ class ServeEngine:
                                 if attempts > self._retry_limit:
                                     break
                                 self._backoff(attempts)
-                    if hit is None:
+                    if hit is None and not adopted:
                         # the miss path — also the landing spot for a
-                        # stale-prefix fallback above (attempts carry
-                        # over into this loop's retry budget)
+                        # stale-prefix fallback above and a failed
+                        # hand-off adoption (attempts carry over into
+                        # this loop's retry budget)
                         bucket = self.prefill_bucket(p)
                         padded = np.full((bucket,), self.pad_id,
                                          np.int32)
@@ -958,27 +1030,43 @@ class ServeEngine:
                     continue
                 if self._faults is not None:
                     poison = self._faults.poison_value(
-                        "serve.prefill", tick=tick, request=req.id,
+                        "serve.handoff" if adopted else "serve.prefill",
+                        tick=tick, request=req.id,
                         replica=self._replica,
                     )
                     if poison is not None:
                         first = int(poison)
                 prefill_s = time.perf_counter() - tp
-                if span is not None:
-                    span.event(
-                        "prefill", tick=tick, bucket=bucket,
-                        ms=round(prefill_s * 1e3, 3), reused=keep,
+                if adopted:
+                    # no program ran: the KV landed by direct write, so
+                    # nothing feeds the dispatch analytics — the event
+                    # timeline records the adoption instead
+                    self.metrics.record_handoff_adopt()
+                    if span is not None:
+                        span.event(
+                            "handoff_adopted", tick=tick, seq_len=p,
+                            ms=round(prefill_s * 1e3, 3),
+                        )
+                    self.recorder.record(
+                        "handoff_adopted", tick=tick, id=req.id,
+                        seq_len=p, ms=round(prefill_s * 1e3, 3),
                     )
-                # the dispatch interval ends at prefill's EXISTING
-                # host sync (int(first_d[0]) above) — analytics adds
-                # none of its own
-                self.metrics.perf.record_dispatch(
-                    family, prefill_s, tokens=1
-                )
-                self.recorder.record(
-                    "dispatch", tick=tick, family=family,
-                    ms=round(prefill_s * 1e3, 3), tokens=1,
-                )
+                else:
+                    if span is not None:
+                        span.event(
+                            "prefill", tick=tick, bucket=bucket,
+                            ms=round(prefill_s * 1e3, 3), reused=keep,
+                        )
+                    # the dispatch interval ends at prefill's EXISTING
+                    # host sync (int(first_d[0]) above) — analytics
+                    # adds none of its own
+                    self.metrics.perf.record_dispatch(
+                        family, prefill_s, tokens=1
+                    )
+                    self.recorder.record(
+                        "dispatch", tick=tick, family=family,
+                        ms=round(prefill_s * 1e3, 3), tokens=1,
+                    )
                 if not self._token_ok(first):
                     # corrupted first token: quarantine before it can
                     # enter results or seed the decode frontier
@@ -986,8 +1074,41 @@ class ServeEngine:
                         req, slot, tick, "poisoned_token"
                     ))
                     continue
-                self.metrics.record_first_token(req, tick, bucket=bucket)
+                self.metrics.record_first_token(
+                    req, tick, bucket=None if adopted else bucket
+                )
                 tokens_this_tick += 1
+                if self.role == "prefill" and not (
+                    len(req.prefix) + 1 >= req.max_new_tokens
+                    or (req.eos_id is not None and first == req.eos_id)
+                ):
+                    # prefill-role terminal (docs/SERVING.md
+                    # "Disaggregated fleet"): the slot's work is done —
+                    # the raw prefill/resume output cache (rows [0, p)
+                    # valid) and the first token ship to a decode
+                    # replica via the outbox. The slot frees; under a
+                    # prefix cache the inserted entry keeps the pages
+                    # alive for future local hits. A request the first
+                    # token already FINISHES (budget or EOS) skips the
+                    # hand-off and completes here via activate below.
+                    self.pool.free(slot)
+                    self._outbox.append({
+                        "id": req.id,
+                        "prompt": np.asarray(req.prompt, np.int32),
+                        "prefix": np.asarray(req.prefix, np.int32),
+                        "length": p,
+                        "first_token": int(first),
+                        "kv": cache,
+                        "max_new_tokens": req.max_new_tokens,
+                        "eos_id": req.eos_id,
+                    })
+                    self.recorder.record(
+                        "handoff_out", tick=tick, id=req.id, seq_len=p,
+                    )
+                    finished.append(
+                        self._sched.handoff_result(req, first, tick)
+                    )
+                    continue
                 done = self._sched.activate(slot, req, first, tick)
                 if done is not None:
                     finished.append(done)
@@ -1012,6 +1133,9 @@ class ServeEngine:
         )
         for res in finished:
             self.metrics.record_finish(res)
+            # a request retired before admission (deadline expiry)
+            # abandons any pending hand-off payload
+            self._handoffs.pop(res.id, None)
             span = self._spans.pop(res.id, None)
             if span is not None:
                 span.end(res.status, tick=res.finish_tick,
@@ -1300,6 +1424,7 @@ class ServeEngine:
         emitted = self._sched.cancel(request_id)
         if emitted is None:
             return None
+        self._handoffs.pop(request_id, None)
         self.metrics.record_cancel()
         span = self._spans.pop(request_id, None)
         if span is not None:
@@ -1320,6 +1445,9 @@ class ServeEngine:
         reqs = self._sched.handoff_all() if not self._dead else []
         out = []
         for req in reqs:
+            # a stolen request's pending KV payload stays behind: the
+            # adopting engine re-prefills from the prompt instead
+            self._handoffs.pop(req.id, None)
             out.append({
                 "id": req.id,
                 "prompt": np.asarray(req.prompt, np.int32),
@@ -1385,6 +1513,78 @@ class ServeEngine:
         self._spans[req.id] = span
         return req.id
 
+    def take_handoffs(self) -> list[dict]:
+        """Drain the prefill-role outbox: every KV hand-off payload
+        produced since the last call, in hand-off order. Returns []
+        on a dead engine — its payloads are unreachable and the fleet
+        re-routes those requests from its own ledger (re-prefill,
+        bit-identical by greedy determinism)."""
+        if self._dead:
+            return []
+        out, self._outbox = self._outbox, []
+        return out
+
+    def adopt_handoff(self, payload: dict) -> int:
+        """Admit a cross-replica KV hand-off payload (the dicts
+        :meth:`take_handoffs` returns, routed here by
+        ``serve/fleet.py``): like :meth:`adopt`, but carrying the
+        source replica's prefill output cache plus the first token, so
+        admission lands the KV by DIRECT write into the leased slot —
+        no prefill program runs here and the continued stream is
+        bit-identical to a local prefill. The write travels the
+        ``serve.handoff`` fault hook; a payload that cannot land falls
+        back to a full local prefill. Returns the new engine-local
+        id."""
+        prompt = np.asarray(payload["prompt"], np.int32)
+        prefix = np.asarray(payload.get("prefix", ()), np.int32)
+        max_new_tokens = int(payload["max_new_tokens"])
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise FriendlyError(
+                f"hand-off payload needs a non-empty 1-D prompt, got "
+                f"shape {prompt.shape}"
+            )
+        if len(prefix) + 1 > max_new_tokens:
+            raise FriendlyError(
+                f"hand-off prefix ({len(prefix)} tokens) + the first "
+                f"token exceed the request budget ({max_new_tokens}); "
+                "the prefill replica should have completed it locally"
+            )
+        if int(payload["length"]) != int(prompt.size) + len(prefix):
+            raise FriendlyError(
+                f"hand-off payload length ({payload['length']}) does "
+                f"not match prompt ({prompt.size}) + prefix "
+                f"({len(prefix)}); the payload is torn"
+            )
+        if int(prompt.size) + max_new_tokens > self.cache_len:
+            raise FriendlyError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds this engine's cache_len "
+                f"({self.cache_len}); hand off to a replica with equal "
+                "cache geometry"
+            )
+        req = ServeRequest(
+            id=self._next_id,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_id=payload.get("eos_id"),
+            deadline_tick=None,
+            submit_tick=self.tick,
+            submit_wall=time.perf_counter(),
+            prefix=prefix,
+        )
+        self._sched.queue.append(req)
+        self._handoffs[req.id] = dict(payload)
+        self._next_id += 1
+        self.metrics.record_submit()
+        span = self._tracer.span(
+            "request", tick=self.tick, id=req.id,
+            prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
+        )
+        span.event("handoff_queued", tick=self.tick,
+                   seq_len=int(payload["length"]))
+        self._spans[req.id] = span
+        return req.id
+
     def health_counters(self) -> dict:
         """The supervisor's probe surface: liveness/readiness inputs in
         one cheap host-side dict (no device sync) — tick progress,
@@ -1394,12 +1594,19 @@ class ServeEngine:
             "tick": self.tick,
             "busy": self.busy,
             "dead": self._dead,
+            "role": self.role,
             "queue_depth": self.queue_depth,
             "active": len(self._sched.active),
             "degraded": self.degraded,
             "slo_burning": (
                 bool(self._slo.should_shed)
                 if self._slo is not None else False
+            ),
+            # consecutive burning SLO evaluations — the fleet
+            # autoscaler's scale-up signal (serve/fleet.py)
+            "slo_burn_ticks": (
+                int(self._slo.burn_ticks)
+                if self._slo is not None else 0
             ),
             "retries_total": self.metrics.retries_total,
             "quarantined_total": self.metrics.quarantined_total,
@@ -1419,6 +1626,9 @@ class ServeEngine:
         if self._dead:
             return
         self._dead = True
+        # undelivered hand-off payloads are unreachable on a dead
+        # engine; the fleet re-routes those requests from its ledger
+        self._outbox.clear()
         leased = self.pool.leased_slots()
         for slot in leased:
             self.pool.free(slot)

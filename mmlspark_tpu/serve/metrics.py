@@ -98,6 +98,13 @@ class ServeMetrics:
         self._snapshots = r.counter(n("serve.snapshots"))
         self._snapshot_failures = r.counter(n("serve.snapshot_failures"))
         self._cancelled = r.counter(n("serve.cancelled"))
+        # disaggregated fleet (docs/SERVING.md "Disaggregated fleet"):
+        # KV hand-off payloads produced by a prefill-role engine,
+        # adopted by a decode-role engine, and adoption failures that
+        # fell back to a full local prefill
+        self._handoffs_out = r.counter(n("serve.handoffs_out"))
+        self._handoffs_adopted = r.counter(n("serve.handoffs_adopted"))
+        self._handoff_fallbacks = r.counter(n("serve.handoff_fallbacks"))
         #: 1 while the engine runs below its configured decode-block
         #: ladder top or admission cap (memory-pressure degradation),
         #: 0 once the recovery probe has re-escalated to full service
@@ -247,6 +254,18 @@ class ServeMetrics:
         return self._cancelled.value
 
     @property
+    def handoffs_out_total(self) -> int:
+        return self._handoffs_out.value
+
+    @property
+    def handoffs_adopted_total(self) -> int:
+        return self._handoffs_adopted.value
+
+    @property
+    def handoff_fallbacks_total(self) -> int:
+        return self._handoff_fallbacks.value
+
+    @property
     def tokens_generated(self) -> int:
         return self._tokens_generated.value
 
@@ -317,10 +336,16 @@ class ServeMetrics:
             self._failed.inc()
         elif result.status == "stalled":
             self._stalled.inc()
+        elif result.status == "handed_off":
+            # terminal on a prefill-role engine only: the request
+            # continues on a decode replica, so it is neither a
+            # completion nor an error here — and it must NOT feed the
+            # SLO error-rate window
+            self._handoffs_out.inc()
         else:
             self._completed.inc()
         self._tokens_generated.inc(result.generated)
-        if self.slo is not None:
+        if self.slo is not None and result.status != "handed_off":
             self.slo.observe_finish(result.status == "completed")
         self._touch()
 
@@ -356,11 +381,40 @@ class ServeMetrics:
         losing copy, or failover dedup)."""
         self._cancelled.inc()
 
-    def ttft_p99_ms(self) -> float | None:
+    def record_handoff_out(self) -> None:
+        """One KV hand-off payload produced (prefill-role engine)."""
+        self._handoffs_out.inc()
+
+    def record_handoff_adopt(self) -> None:
+        """One hand-off payload adopted by direct KV write (no local
+        prefill program ran)."""
+        self._handoffs_adopted.inc()
+
+    def record_handoff_fallback(self) -> None:
+        """One hand-off adoption that failed (fault/retry exhaustion)
+        and fell back to a full local prefill."""
+        self._handoff_fallbacks.inc()
+
+    def ttft_p99_ms(self) -> float:
         """The routing signal the supervisor reads per replica (with
         queue depth): TTFT p99 from the live histogram, no device
-        sync."""
-        return self._ttft_ms.percentile(99)
+        sync. Returns 0.0 on an empty histogram — a cold replica must
+        look CHEAP to route to, and autoscale arithmetic on NaN/None
+        poisons every comparison downstream."""
+        p = self._ttft_ms.percentile(99)
+        return 0.0 if p is None else p
+
+    def per_token_p99_ms(self) -> float:
+        """Per-token decode latency p99; 0.0 on an empty histogram
+        (same cold-replica contract as :meth:`ttft_p99_ms`)."""
+        p = self._per_token_ms.percentile(99)
+        return 0.0 if p is None else p
+
+    def tick_p99_ms(self) -> float:
+        """Scheduler-tick duration p99; 0.0 on an empty histogram
+        (same cold-replica contract as :meth:`ttft_p99_ms`)."""
+        p = self._tick_ms.percentile(99)
+        return 0.0 if p is None else p
 
     def set_degraded(self, degraded: bool) -> None:
         self.degraded_mode = int(degraded)
@@ -483,6 +537,12 @@ class ServeMetrics:
             "snapshots_total": self.snapshots_total,
             "snapshot_failures_total": self.snapshot_failures_total,
             "cancelled_total": self.cancelled_total,
+            # disaggregated fleet (docs/SERVING.md "Disaggregated
+            # fleet"; schema-gated): KV hand-off traffic — zeros on
+            # engines outside a DisaggFleet, so the schema stays fixed
+            "handoffs_out_total": self.handoffs_out_total,
+            "handoffs_adopted_total": self.handoffs_adopted_total,
+            "handoff_fallbacks_total": self.handoff_fallbacks_total,
             # device-level analytics (docs/OBSERVABILITY.md
             # "Device-level performance analytics"; schema-gated):
             # headline utilization, the device-vs-host time split, the

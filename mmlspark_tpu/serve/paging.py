@@ -856,6 +856,20 @@ class PagedCachePool:
             "prefix_tokens_saved_total": int(self.prefix_tokens_saved),
         }
 
+    def refcount_audit(self) -> tuple[int, int]:
+        """``(refcount_total, mapped_references)`` — the allocator's
+        conservation law. Every unit of refcount must be owned by
+        exactly one mapping: a slot page-table entry (``npages`` per
+        slot) or a prefix-cache entry's page list. The fleet tests
+        assert the two are equal on every replica's pool across a
+        hand-off, a failover, and a drain (docs/SERVING.md
+        "Disaggregated fleet") — a leak here is silent HBM loss."""
+        refcount_total = int(self._refcount.sum())
+        mapped = sum(self._npages) + sum(
+            len(e.pages) for e in self._prefix.values()
+        )
+        return refcount_total, int(mapped)
+
     def snapshot(self) -> dict:
         """JSON-able paging state: page tables, refcounts, prefix-cache
         entries. Informational in restore (the engine re-prefills every

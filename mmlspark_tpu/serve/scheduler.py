@@ -61,8 +61,11 @@ class RequestResult:
     (budget or EOS reached), ``"expired"`` (deadline passed while
     queued or mid-decode), ``"failed"`` (quarantined by the engine's
     fault handling — a poisoned token stream or a dispatch failure that
-    retries could not absorb), or ``"stalled"`` (``run()`` hit its
-    ``max_ticks`` bound with the request still pending). For every
+    retries could not absorb), ``"stalled"`` (``run()`` hit its
+    ``max_ticks`` bound with the request still pending), or
+    ``"handed_off"`` (a prefill-role engine finished the prefill and
+    shipped the KV + first token to a decode replica — serve/fleet.py;
+    ``tokens`` then carries prompt + prefix + first token). For every
     non-completed status ``tokens`` carries whatever was generated.
     ``tokens`` includes the prompt, like ``generate()``."""
 
@@ -302,6 +305,24 @@ class ContinuousBatchScheduler:
         while self.queue:
             out.append(self.queue.popleft())
         return out
+
+    def handoff_result(self, req: ServeRequest, first_token: int,
+                       tick: int) -> RequestResult:
+        """Terminal record for a PREFILL-ROLE engine (serve/fleet.py):
+        the request's KV and first token were handed to a decode
+        replica, so it is terminal HERE with status ``"handed_off"``
+        and never activates a decode slot. ``tokens`` carries prompt +
+        resume prefix + the first token — exactly the frontier the
+        decode replica resumes from."""
+        return self._result(
+            req, "handed_off",
+            tokens=np.concatenate([
+                req.prompt, req.prefix,
+                np.asarray([first_token], np.int32),
+            ]),
+            generated=len(req.prefix) + 1,
+            first_token_tick=tick, tick=tick,
+        )
 
     def stall_pending(self, tick: int) -> list[RequestResult]:
         """Retire EVERY still-pending request (queued and active) with

@@ -256,7 +256,9 @@ class ReplicaSet:
         return sorted(live, key=lambda r: (
             _LIVE_RANK[r.state],
             r.engine.queue_depth + r.engine.pool.leased_count,
-            r.engine.metrics.ttft_p99_ms() or 0.0,
+            # 0.0 on a cold replica's empty histogram (the helper's
+            # contract) — cold replicas route as cheapest
+            r.engine.metrics.ttft_p99_ms(),
             r.idx,
         ))
 

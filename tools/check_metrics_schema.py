@@ -192,6 +192,59 @@ REQUIRED_PER_REPLICA_KEYS: dict[str, tuple] = {
     "prefill_compile_count": (int,),
 }
 
+# the --disagg JSON line is DisaggFleet.metrics_dict() (docs/SERVING.md
+# "Disaggregated fleet"): fleet totals (hand-off plane, fleet-wide
+# prefix index, autoscaler) + per-role aggregates + per-replica dicts
+REQUIRED_FLEET_KEYS: dict[str, tuple] = {
+    "disagg": (bool,),
+    "prefill_replicas": (int,),
+    "decode_replicas": (int,),
+    "fleet_ticks": (int,),
+    "submitted": (int,),
+    "completed": (int,),
+    "failed": (int,),
+    "expired": (int,),
+    "stalled": (int,),
+    "tokens_generated": (int,),
+    "tokens_per_sec": NUM + (type(None),),
+    "wall_s": NUM,
+    "ttft_ms_p99": NUM,
+    "handoffs_total": (int,),
+    "handoff_fallbacks_total": (int,),
+    "fleet_prefix_hits_total": (int,),
+    "fleet_prefix_entries": (int,),
+    "fleet_prefill_tokens_saved_total": (int,),
+    "replica_failovers_total": (int,),
+    "drains_total": (int,),
+    "scale_ups_total": (int,),
+    "scale_downs_total": (int,),
+    "parked_prefill": (int,),
+    "parked_decode": (int,),
+    "autoscale": (dict, type(None)),
+    "per_role": (dict,),
+    "per_replica": (dict,),
+}
+
+REQUIRED_FLEET_ROLE_KEYS: dict[str, tuple] = {
+    "replicas": (int,),
+    "submitted": (int,),
+    "tokens_generated": (int,),
+    "queue_depth": (int,),
+    "handoffs_out_total": (int,),
+    "handoffs_adopted_total": (int,),
+    "handoff_fallbacks_total": (int,),
+}
+
+# a fleet replica carries every ReplicaSet per-replica key plus its
+# role and the hand-off counters
+REQUIRED_FLEET_PER_REPLICA_KEYS: dict[str, tuple] = {
+    **REQUIRED_PER_REPLICA_KEYS,
+    "role": (str,),
+    "handoffs_out_total": (int,),
+    "handoffs_adopted_total": (int,),
+    "handoff_fallbacks_total": (int,),
+}
+
 #: engine-emitted event names the trace exporter keys on — renaming
 #: any of these breaks trace.json's tick/dispatch tracks, so the gate
 #: pins their presence in a demo run's events.jsonl
@@ -415,6 +468,134 @@ def check_replica_mode(env: dict, repo: str) -> None:
             )
 
 
+def check_disagg_mode(env: dict, repo: str) -> None:
+    """Disaggregated-fleet smoke run (``--disagg``): the JSON line
+    switches to ``DisaggFleet.metrics_dict()`` (docs/SERVING.md
+    "Disaggregated fleet") — fleet totals + per-role aggregates +
+    per-replica dicts — and the telemetry bundle is the FLEET's
+    recorder/registry (hand-off routings in the timeline, the fleet
+    counters in the exposition). Pin all three shapes."""
+    with tempfile.TemporaryDirectory() as tdir:
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4",
+            "serve", "--demo", "--slots", "2",
+            "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
+            "--disagg", "--prefill-replicas", "1",
+            "--decode-replicas", "2",
+            "--autoscale", "max_decode=3,queue_high=8",
+            "--telemetry-dir", tdir,
+        ]
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env=env, cwd=repo,
+        )
+        if res.returncode != 0:
+            fail(f"serve --demo --disagg exited {res.returncode}:\n"
+                 f"{res.stderr}")
+        out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+        if len(out_lines) != 1:
+            fail(
+                f"--disagg stdout must be exactly ONE JSON line, got "
+                f"{len(out_lines)}:\n{res.stdout}"
+            )
+        try:
+            md = json.loads(out_lines[0])
+        except json.JSONDecodeError as e:
+            fail(f"--disagg stdout line is not JSON: {e}")
+        for key, types in REQUIRED_FLEET_KEYS.items():
+            if key not in md:
+                fail(f"--disagg stdout: missing key {key!r}")
+            if not isinstance(md[key], types):
+                fail(
+                    f"--disagg stdout: key {key!r} has type "
+                    f"{type(md[key]).__name__}, expected one of "
+                    f"{[t.__name__ for t in types]} (value: {md[key]!r})"
+                )
+        if md["disagg"] is not True:
+            fail("--disagg must report disagg == true")
+        if (md["prefill_replicas"], md["decode_replicas"]) != (1, 2):
+            fail(
+                f"--prefill-replicas 1 --decode-replicas 2 must report "
+                f"(1, 2), got ({md['prefill_replicas']}, "
+                f"{md['decode_replicas']})"
+            )
+        if md["completed"] != N_REQUESTS:
+            fail(
+                f"--disagg smoke run must complete all {N_REQUESTS} "
+                f"requests, got {md['completed']}"
+            )
+        if md["handoffs_total"] < 1:
+            fail("--disagg run never routed a hand-off payload")
+        if set(md["per_role"]) != {"prefill", "decode"}:
+            fail(f"per_role must hold prefill/decode, got "
+                 f"{sorted(md['per_role'])}")
+        for role, sub in md["per_role"].items():
+            for key, types in REQUIRED_FLEET_ROLE_KEYS.items():
+                if key not in sub:
+                    fail(f"per_role.{role}: missing key {key!r}")
+                if not isinstance(sub[key], types):
+                    fail(
+                        f"per_role.{role}: key {key!r} has type "
+                        f"{type(sub[key]).__name__}, expected one of "
+                        f"{[t.__name__ for t in types]}"
+                    )
+        if md["per_role"]["prefill"]["handoffs_out_total"] < 1:
+            fail("the prefill role reported zero hand-offs out")
+        if md["per_role"]["decode"]["handoffs_adopted_total"] < 1:
+            fail("the decode role reported zero adopted hand-offs")
+        if not md["per_replica"]:
+            fail("--disagg per_replica is empty")
+        for rname, sub in md["per_replica"].items():
+            for key, types in REQUIRED_FLEET_PER_REPLICA_KEYS.items():
+                if key not in sub:
+                    fail(f"per_replica.{rname}: missing key {key!r}")
+                if not isinstance(sub[key], types):
+                    fail(
+                        f"per_replica.{rname}: key {key!r} has type "
+                        f"{type(sub[key]).__name__}, expected one of "
+                        f"{[t.__name__ for t in types]}"
+                    )
+        # the bundle is the fleet's: hand-off/index/autoscale counters
+        # in the exposition, routing events in the timeline
+        ppath = os.path.join(tdir, "metrics.prom")
+        if not os.path.exists(ppath):
+            fail("--disagg --telemetry-dir did not produce metrics.prom")
+        prom = open(ppath, encoding="utf-8").read()
+        for needle in ("serve_fleet_handoffs_total",
+                       "serve_fleet_prefix_hits_total",
+                       "serve_fleet_prefill_tokens_saved_total",
+                       "serve_scale_ups_total", "serve_scale_downs_total",
+                       "serve_replica_failovers_total",
+                       "serve_drains_total"):
+            if needle not in prom:
+                fail(f"--disagg metrics.prom lacks {needle!r}")
+        epath = os.path.join(tdir, "events.jsonl")
+        try:
+            lines = open(epath, encoding="utf-8").read().splitlines()
+        except OSError as e:
+            fail(f"--disagg events.jsonl unreadable: {e}")
+        names = set()
+        for line in lines[1:]:
+            try:
+                names.add(json.loads(line)["name"])
+            except (json.JSONDecodeError, KeyError) as e:
+                fail(f"--disagg events.jsonl malformed line: {e}")
+        for needle in ("routed", "handoff_routed"):
+            if needle not in names:
+                fail(
+                    f"--disagg events.jsonl lacks {needle!r} "
+                    f"control-plane events (names seen: {sorted(names)})"
+                )
+    print(
+        f"check_metrics_schema: OK — --disagg line carries "
+        f"{len(REQUIRED_FLEET_KEYS)} fleet keys, "
+        f"{len(REQUIRED_FLEET_ROLE_KEYS)} per-role keys and "
+        f"{len(REQUIRED_FLEET_PER_REPLICA_KEYS)} per-replica keys; "
+        f"hand-off plane routed {md['handoffs_total']} payloads; fleet "
+        f"counters present in the exposition"
+    )
+
+
 def check_int8_mode(env: dict, repo: str) -> None:
     """Third smoke pass: the same demo config at ``--kv-dtype bf16``
     and ``--kv-dtype int8`` (+ ``--quantize-weights``). Pins the
@@ -477,6 +658,11 @@ def main() -> None:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "--disagg" in sys.argv[1:]:
+        # the disagg gate in tools/ci.sh runs this surface on its own
+        # (the default run keeps the historical three-surface sweep)
+        check_disagg_mode(env, repo)
+        return
     with tempfile.TemporaryDirectory() as tdir:
         # --mesh makes the run exercise the SHARDED engine, so the gate
         # also pins the mesh topology keys' populated form
