@@ -123,6 +123,7 @@ _GROUPS = {
     "serve_int8": ("serve_int8",),
     "serve_supervisor": ("serve_supervisor",),
     "serve_disagg": ("serve_disagg",),
+    "train_resilience": ("train_resilience",),
 }
 
 #: published peak bf16 FLOPs/s per chip, keyed by substring of device_kind
@@ -1597,6 +1598,170 @@ def bench_train_classifier(jax) -> dict:
     }
 
 
+def bench_train_resilience(jax) -> dict:
+    """Training resilience cost proof (docs/TRAINING.md): the trainer's
+    fault hooks must be FREE when disabled, and the checkpoint/resume
+    machinery's price must be visible. Four figures:
+
+    - ``steps_per_sec_disabled`` vs ``steps_per_sec_disabled_repeat``
+      (two identical ``faults=None`` trainers): the measurement's own
+      noise floor (``noise_pct``);
+    - ``steps_per_sec_hooked``: an injector attached but with NO rates
+      and NO schedule, so every ``train.step``/``train.data`` hook
+      fires into an immediate miss — bounds the hook machinery's
+      per-step host cost (``hook_overhead_pct``; a fixed few-10s-of-µs
+      Python cost, so it shrinks toward zero at real step times);
+    - ``checkpoint_write_ms`` / ``checkpoint_restore_ms``: the atomic
+      store's full save (orbax payload + manifest commit) and restore,
+      best-of-3 on a real params+adam state;
+    - ``resume_replay``: steps re-executed after a kill at a fixed
+      step under ``checkpoint_every`` 1 and 8 — the recovery-cost side
+      of the checkpoint-cadence trade (cadence 1 replays 0).
+
+    Steps/sec come from the flight recorder's per-step event
+    timestamps (``log_every=1`` syncs each step): the MEDIAN
+    inter-step gap over ~250 steps — compile time and host scheduling
+    outliers fall out without subtracting two large wall times."""
+    import shutil
+    import tempfile
+
+    import optax
+
+    from mmlspark_tpu.core.faults import Fault, FaultInjector
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.train.resilience import AtomicCheckpointStore
+    from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
+
+    full = _full_scale(jax)
+    # enough steps per run that the median inter-step gap is
+    # steady-state step time, not compile-time variance
+    n, d, hidden, batch = (
+        (16384, 128, (512, 512), 256) if full else (2048, 16, (32,), 32)
+    )
+    steps_per_epoch = n // batch
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    graph = build_model("mlp", num_outputs=2, hidden=hidden)
+
+    def cfg(epochs, **kw):
+        kw.setdefault("log_every", 1)
+        return TrainConfig(
+            epochs=epochs, batch_size=batch, learning_rate=1e-2,
+            shuffle=False, retry_backoff_s=0.0, **kw,
+        )
+
+    def marginal_sps(faults) -> float:
+        # per-step wall from the recorder's own step-event timestamps:
+        # log_every=1 makes every step a host sync point, so
+        # consecutive-event gaps ARE step times; the median drops the
+        # compile-laden first gap and scheduler outliers
+        from mmlspark_tpu.core.telemetry import FlightRecorder
+
+        rec = FlightRecorder()
+        SPMDTrainer(graph, cfg(4), recorder=rec,
+                    faults=faults).train(x, y)
+        ts = [e["t"] for e in rec.events() if e["name"] == "step"]
+        gaps = np.diff(np.asarray(ts))
+        return 1.0 / max(float(np.median(gaps)), 1e-9)
+
+    marginal_sps(None)  # process warm-up: jax/optax init, first compile
+    # interleaved best-of-3 per config: whole runs land in slow host
+    # periods (the 8-way virtual mesh contends for one CPU), so the
+    # best sustained run is the comparable figure; interleaving keeps
+    # slow periods from loading onto one config. The hooked injector
+    # is live but guaranteed silent (empty schedule, no rates).
+    dis, hkd = [], []
+    for _ in range(3):
+        dis.append(marginal_sps(None))
+        hkd.append(marginal_sps(FaultInjector()))
+    sps_disabled, sps_hooked = max(dis), max(hkd)
+    out: dict = {
+        "steps_per_sec_disabled": round(sps_disabled, 2),
+        "steps_per_sec_disabled_repeat": round(sorted(dis)[-2], 2),
+        "noise_pct": round(
+            (max(dis) - min(dis)) / max(dis) * 100, 2
+        ),
+        "steps_per_sec_hooked": round(sps_hooked, 2),
+        "hook_overhead_pct": round(
+            (sps_disabled / sps_hooked - 1) * 100, 2
+        ),
+    }
+
+    # atomic checkpoint write/restore latency on a real training state
+    import jax.numpy as jnp
+
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, d), jnp.float32)
+    )
+    from mmlspark_tpu.train.trainer import _split_variables
+
+    params, rest = _split_variables(jax.device_get(variables))
+    state = {
+        "params": params, "rest": rest,
+        "opt_state": jax.device_get(optax.adam(1e-3).init(params)),
+        "anomaly": {"streak": np.zeros((), np.int32),
+                    "total": np.zeros((), np.int32)},
+    }
+    ck_dir = tempfile.mkdtemp(prefix="mmltpu-bench-ck-")
+    try:
+        store = AtomicCheckpointStore(ck_dir, max_to_keep=2)
+        store.save(0, state)  # warm-up: orbax checkpointer init
+        write_s = min(
+            _timed(lambda i=i: store.save(i + 1, state)) for i in range(3)
+        )
+        target = jax.tree_util.tree_map(np.zeros_like, state)
+        restore_s = min(
+            _timed(lambda: store.restore(target)) for _ in range(3)
+        )
+        out["checkpoint_write_ms"] = round(write_s * 1e3, 1)
+        out["checkpoint_restore_ms"] = round(restore_s * 1e3, 1)
+        n_bytes = sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(state)
+        )
+        out["checkpoint_bytes"] = n_bytes
+    finally:
+        shutil.rmtree(ck_dir, ignore_errors=True)
+
+    # recovery cost vs checkpoint cadence: kill late in epoch 2, count
+    # the steps the resumed run must re-execute to reach the crash point
+    total = 2 * steps_per_epoch
+    crash_step = total - 3
+    replay: dict = {"crash_step": crash_step, "total_steps": total}
+    for every in (1, 8):
+        rdir = tempfile.mkdtemp(prefix="mmltpu-bench-resume-")
+        try:
+            ck = dict(checkpoint_dir=rdir, checkpoint_every=every)
+            crashed = SPMDTrainer(
+                graph, cfg(2, **ck),
+                faults=FaultInjector(
+                    [Fault("train.step", "kill", tick=crash_step)]
+                ),
+            )
+            try:
+                crashed.train(x, y)
+            except Exception:  # noqa: BLE001 — the EngineKilled drill
+                pass
+            start = AtomicCheckpointStore(rdir).latest_step() + 1
+            resumed = SPMDTrainer(graph, cfg(2, **ck))
+            t_resume = _timed(lambda: resumed.train(x, y))
+            replay[f"checkpoint_every_{every}"] = {
+                "replayed_steps": crash_step - start,
+                "resume_seconds": round(t_resume, 3),
+            }
+        finally:
+            shutil.rmtree(rdir, ignore_errors=True)
+    out["resume_replay"] = replay
+    out["model"] = {"rows": n, "features": d, "hidden": list(hidden),
+                    "batch": batch, "steps_per_epoch": steps_per_epoch}
+    out["timing"] = ("steps/sec = 1 / median inter-step recorder gap at "
+                     "log_every=1, ABBA-ordered disabled/hooked runs; "
+                     "checkpoint save/restore best-of-3; resume drills "
+                     "via an injected kill at a fixed step")
+    return {"train_resilience": out}
+
+
 def bench_trees(jax) -> dict:
     """Seconds per TrainClassifier(model='gbt') fit at census scale —
     the tree family the reference outsources to Spark MLlib
@@ -1980,6 +2145,7 @@ def run(attempt: int) -> dict:
         "serve_int8": lambda: bench_serve_int8(jax),
         "serve_supervisor": lambda: bench_serve_supervisor(jax),
         "serve_disagg": lambda: bench_serve_disagg(jax),
+        "train_resilience": lambda: bench_train_resilience(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),
         "flash_long": lambda: bench_flash_long(jax, jnp),

@@ -10,6 +10,7 @@ Subcommands:
   run <script.py> [args...]   run a user script (the spark-submit role)
   bench                       the repo benchmark (one JSON line)
   serve                       continuous-batching serve demo (one JSON line)
+  train                       fault-tolerant training demo (one JSON line)
   docgen [out_dir]            regenerate API docs (.rst + html)
   config                      print the resolved app config namespace
   env                         print the device/topology view
@@ -93,6 +94,30 @@ def cmd_serve(args) -> int:
         prefill_replicas=args.prefill_replicas,
         decode_replicas=args.decode_replicas,
         autoscale=args.autoscale or None,
+    )
+    print(json.dumps(metrics, default=str))
+    return 0
+
+
+def cmd_train(args) -> int:
+    """Fault-tolerant training demo: synthetic data through an
+    ``SPMDTrainer`` with crash-restart supervision, ONE JSON metrics
+    line out (mirrors ``serve``)."""
+    _apply_backend(args)
+    from mmlspark_tpu.train.demo import run_train_demo
+
+    metrics = run_train_demo(
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        n_samples=args.samples,
+        seed=args.seed,
+        checkpoint_every=args.checkpoint_every,
+        anomaly_limit=args.anomaly_limit,
+        max_grad_norm=args.max_grad_norm,
+        mesh=args.mesh or None,
+        checkpoint_dir=args.checkpoint_dir or None,
+        telemetry_dir=args.telemetry_dir or None,
+        faults=args.faults or None,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -339,6 +364,64 @@ def main(argv: list[str] | None = None) -> int:
         "replicas back to it (docs/SERVING.md 'Disaggregated fleet')",
     )
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "train", help="fault-tolerant training demo (one JSON line)"
+    )
+    sp.add_argument("--epochs", type=int, default=2)
+    sp.add_argument("--batch-size", type=int, default=32)
+    sp.add_argument("--samples", type=int, default=192,
+                    help="synthetic training rows")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument(
+        "--checkpoint-every", type=int, default=1, metavar="K",
+        help="atomic checkpoint cadence in optimizer steps (0 = only "
+        "at the end); each checkpoint carries params, optimizer state, "
+        "the anomaly streak, and the loss history, committed by a "
+        "manifest rename so a torn write keeps the previous one "
+        "restorable (docs/TRAINING.md 'Checkpoint atomicity')",
+    )
+    sp.add_argument(
+        "--checkpoint-dir", default="", metavar="DIR",
+        help="where checkpoints land (default: a fresh temp dir); "
+        "point a second run at the same DIR to resume it bit-exactly",
+    )
+    sp.add_argument(
+        "--anomaly-limit", type=int, default=5, metavar="N",
+        help="abort (FriendlyError + flight-recorder dump) after N "
+        "CONSECUTIVE quarantined gradient steps; each quarantined step "
+        "skips the update without advancing params "
+        "(docs/TRAINING.md 'Anomaly policy')",
+    )
+    sp.add_argument(
+        "--max-grad-norm", type=float, default=0.0, metavar="G",
+        help="treat grad_norm > G as an anomaly too (0 = only "
+        "non-finite loss/grad count)",
+    )
+    sp.add_argument(
+        "--mesh", default="", metavar="AXES",
+        help="train on a (data, model) device mesh, e.g. "
+        "'data=4,model=2': batches shard over the data axis, params "
+        "replicate. Combine with --cpu-mesh N for N virtual CPU "
+        "devices (docs/TRAINING.md)",
+    )
+    sp.add_argument(
+        "--telemetry-dir", default="", metavar="DIR",
+        help="write events.jsonl (step/checkpoint/restore/anomaly/"
+        "retry/degraded timeline), metrics.json, and metrics.prom "
+        "under DIR (docs/OBSERVABILITY.md)",
+    )
+    sp.add_argument(
+        "--faults", default="", metavar="SPEC",
+        help="seeded chaos through the trainer's train.* hook sites, "
+        "e.g. 'seed=7,train.step:transient=0.1,train.data:poison=0.05,"
+        "train.step:kill=0.02': transients retry, poison NaN-batches "
+        "drive the anomaly quarantine, oom walks the gradient-"
+        "accumulation ladder, kill crashes the trainer and the demo "
+        "resumes it from the last committed checkpoint "
+        "(docs/TRAINING.md 'Failure semantics')",
+    )
+    sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser(
         "evidence",

@@ -1,14 +1,18 @@
-"""Deterministic fault injection for the serving resilience layer.
+"""Deterministic fault injection for the serving AND training
+resilience layers.
 
 Large-scale ML systems treat component failure as a design axis, not an
 exception: TensorFlow's runtime recovers workers from checkpointed
 state and retries rather than restarting the job (arXiv:1605.08695 §4).
-To *prove* the serve engine has the same property, failures must be
-reproducible — a chaos test that cannot replay its faults cannot bisect
-a regression. This module is the seeded, schedulable fault source the
-engine's hook points (``serve.prefill``, ``serve.decode``,
-``serve.device_get``, the periodic-checkpoint ``serve.snapshot``) and
-the supervisor's ``serve.health`` probe fire into
+To *prove* the serve engine — and the SPMD trainer beside it — has the
+same property, failures must be reproducible — a chaos test that cannot
+replay its faults cannot bisect a regression. This module is the
+seeded, schedulable fault source the engine's hook points
+(``serve.prefill``, ``serve.decode``, ``serve.device_get``, the
+periodic-checkpoint ``serve.snapshot``), the supervisor's
+``serve.health`` probe, and the trainer's ``train.*`` hook points
+(``train.step``, ``train.data``, ``train.checkpoint``,
+``train.restore`` — docs/TRAINING.md "Failure semantics") fire into
 (docs/OBSERVABILITY.md "Fault injection"):
 
 - **Zero overhead when disabled.** The engine holds ``faults=None`` by
@@ -73,9 +77,22 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 #: cross-replica KV hand-off payload (serve/fleet.py): a fault there
 #: models a lost/corrupt hand-off, and the engine falls back to a full
 #: local prefill so the request still completes bit-identically.
+#: The four ``train.*`` sites are the SPMD trainer's hook points
+#: (train/trainer.py, docs/TRAINING.md): ``train.step`` fires before
+#: each optimizer-step dispatch (transients retry with deterministic
+#: backoff, ``oom`` walks the gradient-accumulation ladder, ``kill``
+#: is the crash the bit-exact-resume drill restores from),
+#: ``train.data`` fires before each host batch pull (``poison`` there
+#: corrupts the batch with NaNs — the injected stand-in for a bad
+#: gradient the anomaly quarantine must skip), ``train.checkpoint``
+#: fires between the checkpoint payload write and the manifest commit
+#: (a fault models a torn mid-write failure; the previous checkpoint
+#: must stay restorable), and ``train.restore`` fires before a resume
+#: reads the store.
 SITES = (
     "serve.prefill", "serve.decode", "serve.device_get",
     "serve.snapshot", "serve.health", "serve.handoff",
+    "train.step", "train.data", "train.checkpoint", "train.restore",
 )
 #: fault kinds fire() raises/sleeps for, in rate-table draw order
 FIRE_KINDS = ("transient", "oom", "stall", "kill")

@@ -270,8 +270,13 @@ class MetricRegistry:
             m = self._metrics[name]
             pname = _prom_name(name)
             if isinstance(m, Counter):
-                out.append(f"# TYPE {pname}_total counter")
-                out.append(f"{pname}_total {m.value}")
+                # counters whose dotted name already carries the
+                # conventional suffix (train.retries_total) must not
+                # come out double-suffixed
+                if not pname.endswith("_total"):
+                    pname += "_total"
+                out.append(f"# TYPE {pname} counter")
+                out.append(f"{pname} {m.value}")
             elif isinstance(m, Gauge):
                 if m.value is None:
                     continue

@@ -1,4 +1,8 @@
 """In-process SPMD training (the reference's external `mpiexec cntk` path
 re-expressed as a jit-compiled sharded train step — SURVEY.md §2.5 row 2)."""
 
+from mmlspark_tpu.train.resilience import (  # noqa: F401
+    AtomicCheckpointStore,
+    next_accum_rung,
+)
 from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig  # noqa: F401

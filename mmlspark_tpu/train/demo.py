@@ -1,0 +1,127 @@
+"""Synthetic-data training demo — the ``train`` subcommand's body and
+``bench.py``'s ``train_resilience`` helpers.
+
+Trains a small MLP classifier on seeded synthetic float blobs through
+:class:`~mmlspark_tpu.train.trainer.SPMDTrainer`, mirroring the serve
+demo's contract: ONE parseable JSON line out, carrying the trainer's
+step-time/loss/grad-norm histograms, the resilience counters
+(``train.retries_total``, ``train.anomalies_skipped``,
+``train.checkpoints``, ``train.checkpoint_failures``), and the run's
+checkpoint/restart summary. The demo owns the restart control loop a
+fleet supervisor would run: an injected ``kill``
+(``--faults 'train.step:kill=...'`` or a schedule) crashes the
+trainer, and the demo rebuilds it to resume from the last atomically
+committed checkpoint — bit-exact, per the drill tests.
+
+Float features on purpose: ``train.data`` poison NaN-corrupts a
+feature row, which is what drives the grad-anomaly quarantine
+(docs/TRAINING.md "Anomaly policy"). With ``telemetry_dir`` set (the
+CLI's ``--telemetry-dir``), the flight-recorder timeline lands in
+``events.jsonl``, the metrics dict in ``metrics.json``, and the
+Prometheus text exposition in ``metrics.prom`` — the schema
+``tools/check_metrics_schema.py --train`` gates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+
+def run_train_demo(*, epochs: int = 2, batch_size: int = 32,
+                   n_samples: int = 192, features: int = 8,
+                   classes: int = 2, hidden: tuple = (16,),
+                   seed: int = 0, log_every: int = 1,
+                   checkpoint_every: int = 1, max_restarts: int = 5,
+                   anomaly_limit: int = 5, max_grad_norm: float = 0.0,
+                   mesh: str | None = None,
+                   checkpoint_dir: str | None = None,
+                   telemetry_dir: str | None = None,
+                   faults: str | None = None) -> dict:
+    """Run the synthetic training loop (with crash-restart supervision);
+    returns the metrics dict the CLI prints as its one JSON line."""
+    from mmlspark_tpu.core.faults import EngineKilled, parse_fault_spec
+    from mmlspark_tpu.core.telemetry import FlightRecorder, MetricRegistry
+    from mmlspark_tpu.parallel.mesh import parse_mesh_axes
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.train.resilience import AtomicCheckpointStore
+    from mmlspark_tpu.train.trainer import SPMDTrainer, TrainConfig
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n_samples, features)).astype(np.float32)
+    w = rng.normal(size=(features, classes)).astype(np.float32)
+    y = np.argmax(
+        x @ w + 0.1 * rng.normal(size=(n_samples, classes)), axis=1
+    )
+    graph = build_model("mlp", num_outputs=classes, hidden=tuple(hidden))
+
+    # the kill-restart drill needs somewhere durable to resume from even
+    # when the caller didn't ask to keep checkpoints
+    ckpt_dir = checkpoint_dir or tempfile.mkdtemp(prefix="mmltpu-train-ck-")
+    cfg = TrainConfig(
+        epochs=epochs, batch_size=batch_size, learning_rate=1e-2,
+        seed=seed, log_every=log_every, shuffle=False,
+        mesh_axes=parse_mesh_axes(mesh) if mesh else None,
+        checkpoint_dir=ckpt_dir, checkpoint_every=checkpoint_every,
+        anomaly_limit=anomaly_limit, max_grad_norm=max_grad_norm,
+        retry_backoff_s=0.0,
+    )
+    # ONE registry + recorder + injector across restarts: the resumed
+    # trainer keeps appending to the same timeline, and the injector's
+    # remaining schedule/rate stream carries over (a respawned process
+    # doesn't reset the world's faults)
+    registry = MetricRegistry()
+    recorder = FlightRecorder()
+    injector = parse_fault_spec(faults) if faults else None
+    if injector is not None and injector.listener is None:
+        def _on_fault(kind: str, site: str) -> None:
+            registry.counter("train.faults_injected_total").inc()
+            recorder.record("fault_injected", kind=kind, site=site)
+        injector.listener = _on_fault
+
+    restarts = 0
+    while True:
+        trainer = SPMDTrainer(graph, cfg, telemetry=registry,
+                              recorder=recorder, faults=injector)
+        try:
+            trainer.train(x, y)
+            break
+        except EngineKilled:
+            # the crash drill: rebuild the trainer and resume from the
+            # last committed checkpoint — the supervisor loop a real
+            # preemption would trigger
+            restarts += 1
+            recorder.record("restart", attempt=restarts)
+            if restarts >= max_restarts:
+                raise
+
+    full_history = trainer.restored_history + trainer.history
+    loss_hist = [h for h in full_history if "loss" in h]
+    out = registry.to_dict()
+    out.update(
+        steps_total=(loss_hist[-1]["step"] + 1) if loss_hist else 0,
+        final_loss=loss_hist[-1]["loss"] if loss_hist else None,
+        restarts=restarts,
+        epochs=epochs,
+        batch_size=batch_size,
+        history_len=len(full_history),
+        checkpoint_steps=AtomicCheckpointStore(ckpt_dir).steps(),
+        checkpoint_dir=ckpt_dir,
+        model_config={"features": features, "classes": classes,
+                      "hidden": list(hidden)},
+    )
+    if injector is not None:
+        out["faults_injected"] = dict(injector.counts)
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+        recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
+        with open(os.path.join(telemetry_dir, "metrics.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(out, f, indent=1, default=str)
+        with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
+                  encoding="utf-8") as f:
+            f.write(registry.to_prometheus())
+    return out

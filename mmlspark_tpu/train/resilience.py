@@ -1,0 +1,209 @@
+"""Training resilience primitives: the atomic checkpoint store and the
+gradient-accumulation degrade ladder (docs/TRAINING.md).
+
+The serving side proved the protocol first (PR 7's ``serve.snapshot``
+hook and the engine's keep-the-previous-snapshot rule); this module is
+the training-side twin. Orbax already writes its own payload atomically
+(temp dir + finalize rename), but a training checkpoint is MORE than
+the orbax payload: the step count, the loss history, the anomaly
+streak, and the data-epoch geometry must commit in the same instant or
+a resume can pair new arrays with a stale cursor. The
+:class:`AtomicCheckpointStore` therefore layers a manifest commit on
+top of orbax:
+
+1. the array payload is written to ``payload-<step>.tmp`` (orbax's own
+   internal atomicity applies inside that directory),
+2. the ``train.checkpoint`` fault hook fires — the drill window where a
+   torn write is injected,
+3. the payload directory is renamed to its final ``payload-<step>``
+   name,
+4. the manifest (step + JSON meta sidecar: history, streak, counters,
+   ``steps_per_epoch``) is written to a temp file and ``os.replace``\\ d
+   to ``step-<step>.json`` — the COMMIT POINT.
+
+A checkpoint exists iff its manifest AND its payload directory both
+exist; anything else (a ``.tmp`` payload, a payload without a
+manifest) is torn debris that :meth:`AtomicCheckpointStore.steps`
+ignores and the next save sweeps, so a crash at ANY point leaves the
+previous complete checkpoint restorable — the property the
+torn-checkpoint drill in ``tests/test_train_resilience.py`` pins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Callable
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger("train.resilience")
+
+_MANIFEST_RE = re.compile(r"^step-(\d+)\.json$")
+_PAYLOAD_RE = re.compile(r"^payload-(\d+)$")
+
+
+class AtomicCheckpointStore:
+    """Manifest-committed checkpoint store over orbax.
+
+    ``pre_commit(step)`` — when given — is called between the payload
+    write and the manifest commit; the trainer wires the
+    ``train.checkpoint`` fault hook there so an injected ``kill``
+    models a mid-write crash: the payload (or its ``.tmp``) is on disk
+    but no manifest references it, and the store still reports the
+    previous step as latest.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 pre_commit: Callable[[int], None] | None = None):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max(int(max_to_keep), 1)
+        self.pre_commit = pre_commit
+        self._ckptr = None  # lazy orbax StandardCheckpointer
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _checkpointer(self):
+        if self._ckptr is None:
+            import orbax.checkpoint as ocp
+
+            self._ckptr = ocp.StandardCheckpointer()
+        return self._ckptr
+
+    # -- layout -------------------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step-{step}.json")
+
+    def _payload_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"payload-{step}")
+
+    # -- inventory ----------------------------------------------------------
+
+    def steps(self) -> list[int]:
+        """Committed steps, ascending: manifest AND payload both
+        present — a manifest whose payload vanished (or the reverse) is
+        a torn write and does not count."""
+        have_manifest = set()
+        have_payload = set()
+        for name in os.listdir(self.directory):
+            m = _MANIFEST_RE.match(name)
+            if m:
+                have_manifest.add(int(m.group(1)))
+                continue
+            m = _PAYLOAD_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                have_payload.add(int(m.group(1)))
+        return sorted(have_manifest & have_payload)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # -- save / restore ------------------------------------------------------
+
+    def save(self, step: int, state: dict, *,
+             meta: dict[str, Any] | None = None) -> None:
+        """Write ``state`` (a pytree of host arrays) + ``meta`` (JSON)
+        as checkpoint ``step``. Atomic: until the final manifest
+        ``os.replace`` lands, :meth:`latest_step` still names the
+        previous checkpoint."""
+        import jax
+        import numpy as np
+
+        step = int(step)
+        final = self._payload_path(step)
+        tmp = final + ".tmp"
+        # sweep debris from a previous torn attempt at this step
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        # orbax rejects bare python/numpy scalars (optimizer step
+        # counts device_get to 0-d values): coerce every leaf to an
+        # ndarray first
+        state = jax.tree_util.tree_map(np.asarray, state)
+        ckptr = self._checkpointer()
+        ckptr.save(tmp, state)
+        # StandardCheckpointer finalizes (its own internal tmp-dir
+        # rename) on a background thread; the payload is only complete
+        # once that commit lands, and our manifest must never reference
+        # a payload orbax is still writing
+        ckptr.wait_until_finished()
+        if self.pre_commit is not None:
+            # the torn-write drill window: a raise here leaves the
+            # payload uncommitted and the previous checkpoint intact
+            self.pre_commit(step)
+        if os.path.isdir(final):
+            # re-save of an already-committed step (same deterministic
+            # state): replace the payload in place
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        manifest = {
+            "format": 1,
+            "step": step,
+            "payload": os.path.basename(final),
+            "meta": meta or {},
+        }
+        mtmp = self._manifest_path(step) + ".tmp"
+        with open(mtmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(mtmp, self._manifest_path(step))  # COMMIT POINT
+        self._prune()
+
+    def restore(self, target: dict, *,
+                step: int | None = None) -> tuple[dict, dict, int]:
+        """Restore ``(state, meta, step)`` for ``step`` (default: the
+        latest committed checkpoint). ``target`` shapes/dtypes the
+        orbax restore so the state comes back exactly as saved."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FriendlyError(
+                    f"no committed checkpoint in {self.directory!r} "
+                    "(torn payloads without a manifest do not count)"
+                )
+        if step not in self.steps():
+            raise FriendlyError(
+                f"checkpoint step {step} is not committed in "
+                f"{self.directory!r}; committed steps: {self.steps()}"
+            )
+        with open(self._manifest_path(step), encoding="utf-8") as f:
+            manifest = json.load(f)
+        state = self._checkpointer().restore(
+            self._payload_path(step), target
+        )
+        return state, manifest.get("meta", {}), int(step)
+
+    # -- retention -----------------------------------------------------------
+
+    def _prune(self) -> None:
+        """Keep the newest ``max_to_keep`` committed checkpoints.
+        Manifest removed FIRST so a crash mid-prune degrades a
+        checkpoint to torn (ignored) rather than leaving a manifest
+        pointing at a deleted payload that :meth:`steps` would have to
+        special-case."""
+        steps = self.steps()
+        for old in steps[:-self.max_to_keep]:
+            try:
+                os.remove(self._manifest_path(old))
+                shutil.rmtree(self._payload_path(old),
+                              ignore_errors=True)
+            except OSError:  # pragma: no cover - best-effort retention
+                _log.warning("could not prune checkpoint %d", old)
+
+
+def next_accum_rung(accum: int, *, batch: int, n_data: int) -> int | None:
+    """Next power-of-two gradient-accumulation rung after ``accum``
+    that still divides the (data-axis rounded) ``batch``, or ``None``
+    when the ladder is exhausted (the micro-batch is already one row
+    per data shard). The trainer walks this on ``RESOURCE_EXHAUSTED``:
+    same optimizer semantics, activations for ``1/accum`` of the batch
+    live at once (docs/TRAINING.md "The accumulation ladder")."""
+    limit = batch // max(n_data, 1)
+    nxt = max(int(accum), 1) * 2
+    while nxt <= limit:
+        if batch % (nxt * n_data) == 0:
+            return nxt
+        nxt *= 2
+    return None

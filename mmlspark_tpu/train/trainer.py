@@ -1,4 +1,5 @@
-"""SPMD data-parallel trainer with step-level checkpointing.
+"""SPMD data-parallel trainer with step-level checkpointing and
+fault-tolerant execution.
 
 Reference training path (CNTKLearner.fit, cntk-train/src/main/scala/
 CNTKLearner.scala:52-162): export the whole dataset to a text file, generate
@@ -14,8 +15,24 @@ TPU-native replacement, per BASELINE.json's north star:
   names appears implicitly from the sharding annotations; scaling-book
   recipe),
 - ``TrainConfig`` replaces generated BrainScript (BrainscriptBuilder.scala),
-- step-level checkpoint/resume via orbax — a capability upgrade the survey
-  flags as required (§5 checkpoint/resume).
+- step-level checkpoint/resume via an atomically-committed manifest over
+  orbax (:mod:`mmlspark_tpu.train.resilience`) — a capability upgrade the
+  survey flags as required (§5 checkpoint/resume).
+
+Resilience (docs/TRAINING.md): the trainer fires the four ``train.*``
+fault hook sites (core/faults.py) and survives each of them —
+transient step/data faults are retried with capped deterministic
+backoff, ``RESOURCE_EXHAUSTED`` walks a power-of-two
+gradient-accumulation ladder instead of dying, non-finite or exploding
+gradients are quarantined IN-GRAPH (params, optimizer state, and model
+stats all revert to the pre-step values, so a skipped step is a pure
+data advance), and a ``kill`` is the crash the bit-exact-resume drill
+restores from: the atomic checkpoint carries params, optimizer state,
+the anomaly streak, the step count, and the loss history, and the
+seed-deterministic data order makes the resumed run bit-identical to
+an uninterrupted one. Every hook is one ``is not None`` check when
+``faults`` is None (the ``train_resilience`` bench group pins the
+overhead to noise).
 """
 
 from __future__ import annotations
@@ -27,8 +44,14 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError, ParamError
+from mmlspark_tpu.core.faults import (
+    EngineKilled,
+    FaultInjector,
+    is_resource_exhausted,
+    is_transient,
+)
 from mmlspark_tpu.core.logging_utils import get_logger
-from mmlspark_tpu.core.telemetry import MetricRegistry
+from mmlspark_tpu.core.telemetry import FlightRecorder, MetricRegistry
 from mmlspark_tpu.models.graph import NamedGraph
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, batch_spec, make_mesh, replicated_spec
 
@@ -87,11 +110,24 @@ class TrainConfig:
     # pairs (see parallel/sharding.py, e.g. TRANSFORMER_TP_RULES); None =
     # fully replicated params (the reference's only strategy)
     param_rules: Any = None
-    # step-level checkpointing (orbax)
+    # step-level checkpointing (train/resilience.py atomic store)
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end
     max_checkpoints: int = 3
     resume: bool = True
+    # -- resilience knobs (docs/TRAINING.md) ----------------------------
+    # abort (FriendlyError + flight-recorder dump) after this many
+    # CONSECUTIVE quarantined steps; the host check syncs at log_every
+    # cadence, so the abort lags the Nth bad step by < log_every steps.
+    # 0 disables the abort (quarantine still skips each bad step).
+    anomaly_limit: int = 5
+    # grad-norm explosion threshold for the quarantine predicate; 0 =
+    # only non-finite loss/grad_norm count as anomalies
+    max_grad_norm: float = 0.0
+    # capped retries for transient train.step/train.data/train.restore
+    # faults, with deterministic linear backoff retry_backoff_s*attempt
+    retry_limit: int = 3
+    retry_backoff_s: float = 0.0
 
 
 def _make_optimizer(cfg: TrainConfig, total_steps: int):
@@ -183,37 +219,105 @@ class SPMDTrainer:
     ``train(x, y)`` owns the epoch loop; the per-step program is compiled
     once (fixed shapes from the feed layer) and reused — the analog of the
     reference's single external training run, minus the process boundary.
+
+    ``faults`` (a :class:`~mmlspark_tpu.core.faults.FaultInjector`, or
+    None) drives the ``train.*`` drill sites; ``recorder`` collects the
+    step/checkpoint/restore/anomaly/retry/degraded event timeline
+    (docs/TRAINING.md "Failure semantics").
     """
 
     def __init__(self, graph: NamedGraph, config: TrainConfig,
-                 telemetry: MetricRegistry | None = None):
+                 telemetry: MetricRegistry | None = None,
+                 recorder: FlightRecorder | None = None,
+                 faults: FaultInjector | None = None):
         self.graph = graph
         self.config = config
         self.history: list[dict] = []
+        #: loss-curve entries carried over from a restored checkpoint's
+        #: manifest — kept SEPARATE from :attr:`history` (this run's own
+        #: curve) so step arithmetic over ``history`` is resume-invariant;
+        #: ``restored_history + history`` is the full curve and is what
+        #: the next checkpoint persists
+        self.restored_history: list[dict] = []
         #: per-trainer metric registry (core/telemetry): step-time,
         #: tokens/sec, loss, and grad-norm histograms, recorded at
         #: ``log_every`` cadence — ``telemetry.to_dict()`` is the flat
         #: percentile view (docs/OBSERVABILITY.md)
         self.telemetry = telemetry if telemetry is not None \
             else MetricRegistry()
+        #: flight recorder (core/telemetry): the trainer's event
+        #: timeline, dumped automatically when a FriendlyError (e.g.
+        #: the anomaly abort) escapes ``train()``
+        self.recorder = recorder if recorder is not None \
+            else FlightRecorder()
+        self._faults = faults
+        self._step = 0  # current global step, for the fault listener's tick
+        if faults is not None and faults.listener is None:
+            # injected faults land in the same metrics + event timeline
+            # as their consequences (retries, quarantines, degradation)
+            def _on_fault(kind: str, site: str) -> None:
+                self.telemetry.counter("train.faults_injected_total").inc()
+                self.recorder.record(
+                    "fault_injected", tick=self._step, kind=kind, site=site,
+                )
+            faults.listener = _on_fault
+        # pre-created so the exported schema is stable whether or not a
+        # fault ever fires (tools/check_metrics_schema.py --train)
+        for name in ("train.retries_total", "train.anomalies_skipped",
+                     "train.checkpoints", "train.checkpoint_failures",
+                     "train.faults_injected_total"):
+            self.telemetry.counter(name)
+        self.telemetry.gauge("train.grad_accum").set(
+            max(int(config.grad_accum), 1)
+        )
 
     # -- checkpointing ------------------------------------------------------
 
-    def _ckpt_manager(self):
+    def _ckpt_store(self):
         cfg = self.config
         if not cfg.checkpoint_dir:
             return None
-        import os
+        from mmlspark_tpu.train.resilience import AtomicCheckpointStore
 
-        import orbax.checkpoint as ocp
+        def pre_commit(step: int) -> None:
+            # the torn-write drill window: fires between the payload
+            # write and the manifest commit (docs/TRAINING.md
+            # "Checkpoint atomicity")
+            if self._faults is not None:
+                self._faults.fire("train.checkpoint", tick=step)
 
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=cfg.max_checkpoints,
-            save_interval_steps=max(cfg.checkpoint_every, 1),
+        return AtomicCheckpointStore(
+            cfg.checkpoint_dir, max_to_keep=cfg.max_checkpoints,
+            pre_commit=pre_commit,
         )
-        return ocp.CheckpointManager(
-            os.path.abspath(cfg.checkpoint_dir), options=options
-        )
+
+    # -- fault hooks --------------------------------------------------------
+
+    def _fire_hook(self, site: str, tick: int) -> None:
+        """Fire one fault hook site; transient faults are absorbed by up
+        to ``retry_limit`` retries with deterministic linear backoff.
+        Fired BEFORE the guarded work (dispatch, batch use, restore
+        read) so a raised fault never consumes donated buffers and a
+        retry is always safe. OOM/kill escape to the caller's policy."""
+        if self._faults is None:
+            return
+        cfg = self.config
+        attempt = 0
+        while True:
+            try:
+                self._faults.fire(site, tick=tick)
+                return
+            except Exception as e:
+                if is_transient(e) and attempt < cfg.retry_limit:
+                    attempt += 1
+                    self.telemetry.counter("train.retries_total").inc()
+                    self.recorder.record(
+                        "retry", tick=tick, site=site, attempt=attempt,
+                    )
+                    if cfg.retry_backoff_s:
+                        time.sleep(cfg.retry_backoff_s * attempt)
+                    continue
+                raise
 
     # -- main loop ----------------------------------------------------------
 
@@ -225,10 +329,19 @@ class SPMDTrainer:
         eval_fn: Callable[[dict], dict] | None = None,
     ) -> dict:
         """Run the configured number of epochs over (x, y); returns trained
-        variables. Resumes from the newest checkpoint when configured."""
+        variables. Resumes from the newest committed checkpoint when
+        configured. A :class:`FriendlyError` escaping this call (the
+        anomaly-streak abort, an exhausted accumulation ladder) dumps
+        the flight recorder first — the black-box contract."""
+        with self.recorder.dump_on_friendly_error():
+            return self._train_impl(x, y, init_variables, eval_fn)
+
+    def _train_impl(self, x, y, init_variables, eval_fn) -> dict:
         import jax
         import jax.numpy as jnp
         import optax
+
+        from mmlspark_tpu.train.resilience import next_accum_rung
 
         cfg = self.config
         n = len(x)
@@ -250,20 +363,46 @@ class SPMDTrainer:
         params, rest = _split_variables(init_variables)
         opt_state = tx.init(params)
         step0 = 0
+        # in-graph anomaly carries: consecutive-bad-step streak and the
+        # cumulative quarantined-step count, donated alongside the state
+        # so the quarantine costs no extra host syncs
+        streak0 = np.zeros((), np.int32)
+        anoms0 = np.zeros((), np.int32)
+        seen_anoms = 0  # last total synced into the per-run counter
 
-        mngr = self._ckpt_manager()
-        if mngr is not None and cfg.resume and mngr.latest_step() is not None:
-            import orbax.checkpoint as ocp
-
-            latest = mngr.latest_step()
-            target = {"params": params, "rest": rest, "opt_state": opt_state}
-            restored = mngr.restore(
-                latest, args=ocp.args.StandardRestore(target)
-            )
+        store = self._ckpt_store()
+        if store is not None and cfg.resume and store.latest_step() is not None:
+            latest = store.latest_step()
+            # train.restore drill site: transient -> retried read,
+            # kill -> the restore itself crashed (escape)
+            self._fire_hook("train.restore", latest)
+            target = {
+                "params": jax.device_get(params),
+                "rest": jax.device_get(rest),
+                "opt_state": jax.device_get(opt_state),
+                "anomaly": {"streak": streak0, "total": anoms0},
+            }
+            restored, meta, latest = store.restore(target)
             params = restored["params"]
             rest = restored["rest"]
             opt_state = restored["opt_state"]
+            streak0 = restored["anomaly"]["streak"]
+            anoms0 = restored["anomaly"]["total"]
+            seen_anoms = int(anoms0)
+            self.restored_history = list(meta.get("history", []))
+            spe = meta.get("steps_per_epoch")
+            if spe is not None and int(spe) != steps_per_epoch:
+                raise FriendlyError(
+                    f"checkpoint at {cfg.checkpoint_dir!r} was taken with "
+                    f"steps_per_epoch={spe} but this run computes "
+                    f"{steps_per_epoch} (batch {batch} over {n_data} data "
+                    "shards): elastic resume needs a batch_size divisible "
+                    "by both the old and new data-axis widths so the "
+                    "deterministic data order is unchanged"
+                )
             step0 = latest + 1
+            self.recorder.record("restore", tick=latest,
+                                 anomalies_total=seen_anoms)
             _log.info("resumed from checkpoint step %d", latest)
 
         data_sh = batch_spec(mesh)
@@ -272,6 +411,7 @@ class SPMDTrainer:
         loss_kind = cfg.loss
 
         aux_w = cfg.moe_aux_weight
+        max_gnorm = float(cfg.max_grad_norm)
         # forward the padding mask only to graphs that accept it (user
         # duck-typed graphs may predate the mask kwarg)
         import inspect
@@ -295,70 +435,107 @@ class SPMDTrainer:
                 f"({accum * n_data})"
             )
 
-        def step_fn(params, rest, opt_state, bx, by, bmask):
-            def loss_fn(p, r, mx, my, mm):
-                variables = _merge_variables(p, r)
-                out, updated = fwd(variables, mx, mm)
-                loss = masked_loss(loss_kind, out, my, mm)
-                loss = loss + aux_w * _sown_aux_loss(updated)
-                _, new_rest = _split_variables(updated)
-                return loss, new_rest
+        def make_step_fn(accum: int):
+            """One optimizer step at the given accumulation rung, with the
+            in-graph anomaly quarantine fused at the end."""
 
-            if accum == 1:
-                (loss, new_rest), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params, rest, bx, by, bmask)
-            else:
-                # micro-batch scan: grads sum in f32 param space, ONE
-                # optimizer update at the end — activations for only one
-                # micro-batch are ever live. Two exactness details:
-                # - STRIDED split (row i -> micro i % accum): each
-                #   device's contiguous data-axis shard feeds every
-                #   micro-batch locally (a contiguous split would move
-                #   whole micro-batches across the mesh every step), and
-                #   the padded tail spreads over micro-batches;
-                # - WEIGHTED accumulation: each micro contributes its
-                #   masked loss SUM and mask count, normalized once at
-                #   the end — uniform averaging of per-micro means would
-                #   shrink the step by up to accum when padding
-                #   concentrates in some micro-batches (masked_loss
-                #   normalizes by its own batch's count).
-                split = lambda t: t.reshape(  # noqa: E731
-                    t.shape[0] // accum, accum, *t.shape[1:]
-                ).swapaxes(0, 1)
+            def step_fn(params, rest, opt_state, streak, anoms,
+                        bx, by, bmask):
+                def loss_fn(p, r, mx, my, mm):
+                    variables = _merge_variables(p, r)
+                    out, updated = fwd(variables, mx, mm)
+                    loss = masked_loss(loss_kind, out, my, mm)
+                    loss = loss + aux_w * _sown_aux_loss(updated)
+                    _, new_rest = _split_variables(updated)
+                    return loss, new_rest
 
-                def sum_loss_fn(p, r, mx, my, mm):
-                    l, r2 = loss_fn(p, r, mx, my, mm)
-                    cnt = jnp.sum(mm.astype(jnp.float32))
-                    return l * jnp.maximum(cnt, 1.0), (r2, cnt)
+                if accum == 1:
+                    (loss, new_rest), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params, rest, bx, by, bmask)
+                else:
+                    # micro-batch scan: grads sum in f32 param space, ONE
+                    # optimizer update at the end — activations for only one
+                    # micro-batch are ever live. Two exactness details:
+                    # - STRIDED split (row i -> micro i % accum): each
+                    #   device's contiguous data-axis shard feeds every
+                    #   micro-batch locally (a contiguous split would move
+                    #   whole micro-batches across the mesh every step), and
+                    #   the padded tail spreads over micro-batches;
+                    # - WEIGHTED accumulation: each micro contributes its
+                    #   masked loss SUM and mask count, normalized once at
+                    #   the end — uniform averaging of per-micro means would
+                    #   shrink the step by up to accum when padding
+                    #   concentrates in some micro-batches (masked_loss
+                    #   normalizes by its own batch's count).
+                    split = lambda t: t.reshape(  # noqa: E731
+                        t.shape[0] // accum, accum, *t.shape[1:]
+                    ).swapaxes(0, 1)
 
-                def body(carry, xs):
-                    gsum, lsum, csum, r = carry
-                    (ls, (r, cnt)), g = jax.value_and_grad(
-                        sum_loss_fn, has_aux=True
-                    )(params, r, *xs)
-                    gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
-                    return (gsum, lsum + ls, csum + cnt, r), None
+                    def sum_loss_fn(p, r, mx, my, mm):
+                        l, r2 = loss_fn(p, r, mx, my, mm)
+                        cnt = jnp.sum(mm.astype(jnp.float32))
+                        return l * jnp.maximum(cnt, 1.0), (r2, cnt)
 
-                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-                f0 = jnp.asarray(0.0, jnp.float32)
-                (gsum, lsum, csum, new_rest), _ = jax.lax.scan(
-                    body,
-                    (zero, f0, f0, rest),
-                    (split(bx), split(by), split(bmask)),
+                    def body(carry, xs):
+                        gsum, lsum, csum, r = carry
+                        (ls, (r, cnt)), g = jax.value_and_grad(
+                            sum_loss_fn, has_aux=True
+                        )(params, r, *xs)
+                        gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                        return (gsum, lsum + ls, csum + cnt, r), None
+
+                    zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                    f0 = jnp.asarray(0.0, jnp.float32)
+                    (gsum, lsum, csum, new_rest), _ = jax.lax.scan(
+                        body,
+                        (zero, f0, f0, rest),
+                        (split(bx), split(by), split(bmask)),
+                    )
+                    denom = jnp.maximum(csum, 1.0)
+                    grads = jax.tree_util.tree_map(
+                        lambda t: t / denom, gsum
+                    )
+                    loss = lsum / denom
+                # global grad norm BEFORE the optimizer transform: the
+                # scale-blowup/vanishing signal the telemetry histograms
+                # track — one extra scalar through the existing fetch
+                gnorm = optax.global_norm(grads)
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                # grad-anomaly quarantine (docs/TRAINING.md): a non-finite
+                # loss/grad-norm (or an explosion past max_grad_norm)
+                # reverts params, optimizer state, AND model stats to the
+                # pre-step values — the update is skipped entirely and
+                # the optimizer's own step count does not advance. On a
+                # healthy step every select picks the new leaf, so the
+                # quarantine is bit-invisible to anomaly-free runs.
+                bad = jnp.logical_or(
+                    jnp.logical_not(jnp.isfinite(loss)),
+                    jnp.logical_not(jnp.isfinite(gnorm)),
                 )
-                denom = jnp.maximum(csum, 1.0)
-                grads = jax.tree_util.tree_map(
-                    lambda t: t / denom, gsum
-                )
-                loss = lsum / denom
-            # global grad norm BEFORE the optimizer transform: the
-            # scale-blowup/vanishing signal the telemetry histograms
-            # track — one extra scalar through the existing fetch
-            gnorm = optax.global_norm(grads)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            return new_params, new_rest, new_opt, loss, gnorm
+                if max_gnorm > 0.0:
+                    bad = jnp.logical_or(bad, gnorm > max_gnorm)
+
+                def keep(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda nl, ol: jnp.where(bad, ol, nl), new, old
+                    )
+
+                new_params = keep(new_params, params)
+                new_opt = keep(new_opt, opt_state)
+                new_rest = keep(new_rest, rest)
+                streak = jnp.where(bad, streak + 1,
+                                   jnp.zeros_like(streak))
+                anoms = anoms + bad.astype(anoms.dtype)
+                return (new_params, new_rest, new_opt, streak, anoms,
+                        loss, gnorm)
+
+            return step_fn
+
+        k_steps = max(int(cfg.steps_per_dispatch), 1)
+        if cfg.param_rules:
+            k_steps = 1  # TP branch compiles without explicit shardings
 
         if cfg.param_rules:
             # tensor parallelism: shard params per rule set; optimizer
@@ -389,54 +566,163 @@ class SPMDTrainer:
                 opt_state,
             )
             rest = jax.device_put(rest, rep_sh)
-            jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
         else:
-            jitted = jax.jit(
-                step_fn,
-                in_shardings=(
-                    rep_sh, rep_sh, rep_sh, data_sh, data_sh, data_sh,
-                ),
-                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh, rep_sh),
-                donate_argnums=(0, 1, 2),
-            )
-
             params = jax.device_put(params, rep_sh)
             rest = jax.device_put(rest, rep_sh)
             opt_state = jax.device_put(opt_state, rep_sh)
+        streak_dev = jax.device_put(jnp.asarray(streak0, jnp.int32), rep_sh)
+        anoms_dev = jax.device_put(jnp.asarray(anoms0, jnp.int32), rep_sh)
 
-        k_steps = max(int(cfg.steps_per_dispatch), 1)
-        if cfg.param_rules:
-            k_steps = 1  # TP branch compiles without explicit shardings
-        chunk_jitted = chunk_sh = None
-        if k_steps > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-            def chunk_fn(params, rest, opt_state, bxs, bys, bms):
-                def body(carry, xs):
-                    p, r, o = carry
-                    p, r, o, loss, gnorm = step_fn(p, r, o, *xs)
-                    return (p, r, o), (loss, gnorm)
+        # batch dim is axis 1 of the (K, batch, ...) stacks
+        chunk_sh = NamedSharding(mesh, P(None, DATA_AXIS))
 
-                (params, rest, opt_state), (losses, gnorms) = jax.lax.scan(
-                    body, (params, rest, opt_state), (bxs, bys, bms)
-                )
-                return params, rest, opt_state, losses[-1], gnorms[-1]
-
-            # batch dim is axis 1 of the (K, batch, ...) stacks
-            chunk_sh = NamedSharding(mesh, P(None, DATA_AXIS))
-            chunk_jitted = jax.jit(
-                chunk_fn,
+        def build_programs(accum: int):
+            """Compile the step (and K-step chunk) programs at one
+            accumulation rung. Called once up front and once per rung
+            the OOM degrade ladder descends to — one compile per rung,
+            the same honesty as serve's decode-block ladder."""
+            step_fn = make_step_fn(accum)
+            if cfg.param_rules:
+                jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4))
+                return jitted, None
+            jitted = jax.jit(
+                step_fn,
                 in_shardings=(
-                    rep_sh, rep_sh, rep_sh, chunk_sh, chunk_sh, chunk_sh,
+                    rep_sh, rep_sh, rep_sh, rep_sh, rep_sh,
+                    data_sh, data_sh, data_sh,
                 ),
-                out_shardings=(rep_sh, rep_sh, rep_sh, rep_sh, rep_sh),
-                donate_argnums=(0, 1, 2),
+                out_shardings=(rep_sh,) * 7,
+                donate_argnums=(0, 1, 2, 3, 4),
             )
+            chunk_jitted = None
+            if k_steps > 1:
+                def chunk_fn(params, rest, opt_state, streak, anoms,
+                             bxs, bys, bms):
+                    def body(carry, xs):
+                        p, r, o, s, a = carry
+                        p, r, o, s, a, loss, gnorm = step_fn(
+                            p, r, o, s, a, *xs
+                        )
+                        return (p, r, o, s, a), (loss, gnorm)
+
+                    (params, rest, opt_state, streak, anoms), \
+                        (losses, gnorms) = jax.lax.scan(
+                            body, (params, rest, opt_state, streak, anoms),
+                            (bxs, bys, bms),
+                        )
+                    return (params, rest, opt_state, streak, anoms,
+                            losses[-1], gnorms[-1])
+
+                chunk_jitted = jax.jit(
+                    chunk_fn,
+                    in_shardings=(
+                        rep_sh, rep_sh, rep_sh, rep_sh, rep_sh,
+                        chunk_sh, chunk_sh, chunk_sh,
+                    ),
+                    out_shardings=(rep_sh,) * 7,
+                    donate_argnums=(0, 1, 2, 3, 4),
+                )
+            return jitted, chunk_jitted
+
+        jitted, chunk_jitted = build_programs(accum)
+
+        def guarded_fire(tick: int) -> None:
+            """The ``train.step`` hook + its resilience policy, fired
+            BEFORE the jitted call (donated buffers survive a raised
+            fault): transients are retried inside :meth:`_fire_hook`;
+            RESOURCE_EXHAUSTED walks down the power-of-two accumulation
+            ladder and recompiles; ``kill`` escapes — the crash drill
+            the atomic checkpoint restores from."""
+            nonlocal accum, jitted, chunk_jitted
+            while True:
+                try:
+                    self._fire_hook("train.step", tick)
+                    return
+                except Exception as e:
+                    if is_resource_exhausted(e):
+                        nxt = next_accum_rung(accum, batch=batch,
+                                              n_data=n_data)
+                        if nxt is None:
+                            raise FriendlyError(
+                                f"RESOURCE_EXHAUSTED at step {tick} with "
+                                f"the gradient-accumulation ladder "
+                                f"exhausted (grad_accum={accum}, batch "
+                                f"{batch} over {n_data} data shards) — "
+                                "reduce batch_size or model size"
+                            ) from e
+                        accum = nxt
+                        self.telemetry.gauge("train.grad_accum").set(accum)
+                        self.recorder.record("degraded", tick=tick,
+                                             grad_accum=accum)
+                        _log.warning(
+                            "step %d: RESOURCE_EXHAUSTED -> degrading to "
+                            "grad_accum=%d and recompiling", tick, accum,
+                        )
+                        jitted, chunk_jitted = build_programs(accum)
+                        continue
+                    raise
+
+        def pull_guard(b: dict, tick: int) -> dict:
+            """The ``train.data`` hook: transients retried, poison
+            NaN-corrupts the first float feature/label row — the
+            injected stand-in for a bad gradient the quarantine must
+            skip."""
+            self._fire_hook("train.data", tick)
+            if self._faults.poison_value("train.data", tick=tick) is None:
+                return b
+            b = dict(b)
+            for col in ("x", "y"):
+                arr = np.asarray(b[col])
+                if np.issubdtype(arr.dtype, np.floating):
+                    arr = np.array(arr, copy=True)
+                    arr[0] = np.nan
+                    b[col] = arr
+                    break
+            else:
+                _log.warning(
+                    "train.data poison skipped at step %d: no float "
+                    "column to corrupt", tick,
+                )
+            return b
+
+        def save_checkpoint(at_step: int) -> None:
+            """Atomic checkpoint of the full resume state. Failures
+            (other than the ``kill`` crash drill) are counted and
+            skipped — the previous committed checkpoint stands."""
+            state = {
+                "params": jax.device_get(params),
+                "rest": jax.device_get(rest),
+                "opt_state": jax.device_get(opt_state),
+                "anomaly": {
+                    "streak": jax.device_get(streak_dev),
+                    "total": jax.device_get(anoms_dev),
+                },
+            }
+            meta = {
+                "steps_per_epoch": steps_per_epoch,
+                "history": self.restored_history + self.history,
+            }
+            try:
+                store.save(at_step, state, meta=meta)
+            except EngineKilled:
+                raise  # the torn-write crash drill escapes train()
+            except Exception as e:
+                self.telemetry.counter("train.checkpoint_failures").inc()
+                self.recorder.record("checkpoint", tick=at_step, ok=False,
+                                     error=type(e).__name__)
+                _log.warning("checkpoint at step %d failed (%s); previous "
+                             "checkpoint stands", at_step, e)
+                return
+            self.telemetry.counter("train.checkpoints").inc()
+            self.recorder.record("checkpoint", tick=at_step, ok=True)
 
         from mmlspark_tpu.data.feed import MASK_COL, batch_iterator
         from mmlspark_tpu.data.dataset import Dataset
 
         step = step0
+        self._step = step
         start_epoch = step0 // steps_per_epoch
         # Mid-epoch resume: per-epoch shuffle is seed-deterministic, so
         # skipping the first (step0 % steps_per_epoch) batches reproduces the
@@ -473,27 +759,37 @@ class SPMDTrainer:
             )
             for group in grouped(it):
                 t_group = time.perf_counter()
+                self._step = step
+                if self._faults is not None:
+                    group = [pull_guard(b, step + i)
+                             for i, b in enumerate(group)]
                 if k_steps > 1 and len(group) == k_steps:
-                    stacks = (
+                    guarded_fire(step)
+                    stacks = tuple(
                         jax.device_put(
                             jnp.stack([jnp.asarray(b[c]) for b in group]),
                             chunk_sh,
                         )
                         for c in ("x", "y", MASK_COL)
                     )
-                    params, rest, opt_state, loss, gnorm = chunk_jitted(
-                        params, rest, opt_state, *stacks
+                    (params, rest, opt_state, streak_dev, anoms_dev,
+                     loss, gnorm) = chunk_jitted(
+                        params, rest, opt_state, streak_dev, anoms_dev,
+                        *stacks,
                     )
                     n_done = len(group)
                 else:
-                    for b in group:
+                    for i, b in enumerate(group):
+                        guarded_fire(step + i)
                         bx = jax.device_put(jnp.asarray(b["x"]), data_sh)
                         by = jax.device_put(jnp.asarray(b["y"]), data_sh)
                         bm = jax.device_put(
                             jnp.asarray(b[MASK_COL]), data_sh
                         )
-                        params, rest, opt_state, loss, gnorm = jitted(
-                            params, rest, opt_state, bx, by, bm
+                        (params, rest, opt_state, streak_dev, anoms_dev,
+                         loss, gnorm) = jitted(
+                            params, rest, opt_state, streak_dev,
+                            anoms_dev, bx, by, bm,
                         )
                     n_done = len(group)
                 # log once if any step in [step, step+n) hits the cadence;
@@ -501,6 +797,7 @@ class SPMDTrainer:
                 # with that step (chunking coarsens cadence, never lies)
                 next_log = step + (-step) % log_every
                 step += n_done
+                self._step = step
                 if next_log < step:
                     loss_val = float(loss)
                     gnorm_val = float(gnorm)
@@ -515,40 +812,51 @@ class SPMDTrainer:
                     tel.histogram("train.tokens_per_sec").record(
                         tokens_per_step / step_s
                     )
-                    tel.histogram("train.loss").record(loss_val)
-                    tel.histogram("train.grad_norm").record(gnorm_val)
+                    # a quarantined step's loss/gnorm is non-finite by
+                    # definition — keep it out of the log-bucketed
+                    # histograms (history and the anomaly counters carry
+                    # the honest record)
+                    if np.isfinite(loss_val):
+                        tel.histogram("train.loss").record(loss_val)
+                    if np.isfinite(gnorm_val):
+                        tel.histogram("train.grad_norm").record(gnorm_val)
                     self.history.append(
                         {"step": step - 1, "epoch": epoch, "loss": loss_val,
                          "grad_norm": gnorm_val}
+                    )
+                    self.recorder.record(
+                        "step", tick=step - 1, epoch=epoch, loss=loss_val,
+                        grad_norm=gnorm_val,
                     )
                     _log.info(
                         "step %d epoch %d loss %.5f grad_norm %.4f "
                         "step_ms %.1f", step - 1, epoch, loss_val,
                         gnorm_val, step_s * 1e3,
                     )
+                    # anomaly accounting rides the log-cadence sync the
+                    # loss fetch above already paid for: the quarantine
+                    # itself is in-graph; the host only reads the
+                    # counters here, so the N-consecutive abort lags the
+                    # Nth bad step by < log_every steps
+                    self._check_anomalies(streak_dev, anoms_dev,
+                                          seen_anoms, step - 1)
+                    seen_anoms = max(seen_anoms, int(anoms_dev))
                 if (
-                    mngr is not None
+                    store is not None
                     and cfg.checkpoint_every
                     # any step of the finished group on the save cadence
                     # triggers a save of the current (group-end) state —
                     # with chunked dispatch the exact cadence step has no
                     # materialized state of its own
                     and any(
-                        mngr.should_save(s)
+                        s % cfg.checkpoint_every == 0
                         for s in range(step - n_done, step)
                     )
                 ):
-                    # gate BEFORE building args: _ckpt_args device_gets the
-                    # whole (possibly TP-sharded) state, which would stall
-                    # async dispatch on every non-checkpoint step
-                    # force: the any() guard above IS the cadence decision;
-                    # orbax would otherwise re-gate on the group-end step,
-                    # which is generally off-cadence under chunked dispatch
-                    mngr.save(
-                        step - 1,
-                        args=_ckpt_args(params, rest, opt_state),
-                        force=True,
-                    )
+                    # gate BEFORE fetching: save_checkpoint device_gets
+                    # the whole (possibly TP-sharded) state, which would
+                    # stall async dispatch on every non-checkpoint step
+                    save_checkpoint(step - 1)
             if eval_fn is not None:
                 variables = _merge_variables(
                     jax.device_get(params), jax.device_get(rest)
@@ -556,11 +864,12 @@ class SPMDTrainer:
                 metrics = eval_fn(variables)
                 self.history.append({"step": step, "epoch": epoch, **metrics})
 
-        if mngr is not None:
-            if mngr.latest_step() != step - 1:
-                mngr.save(step - 1, args=_ckpt_args(params, rest, opt_state),
-                          force=True)
-            mngr.wait_until_finished()
+        # end-of-run anomaly sweep: catches a terminal bad streak that
+        # never crossed a log-cadence sync point
+        self._check_anomalies(streak_dev, anoms_dev, seen_anoms, step - 1)
+        seen_anoms = max(seen_anoms, int(anoms_dev))
+        if store is not None and store.latest_step() != step - 1:
+            save_checkpoint(step - 1)
         final_loss = next(
             (h["loss"] for h in reversed(self.history) if "loss" in h), None
         )
@@ -568,14 +877,32 @@ class SPMDTrainer:
                   final_loss)
         return _merge_variables(jax.device_get(params), jax.device_get(rest))
 
-
-def _ckpt_args(params, rest, opt_state):
-    import jax
-    import orbax.checkpoint as ocp
-
-    state = {
-        "params": jax.device_get(params),
-        "rest": jax.device_get(rest),
-        "opt_state": jax.device_get(opt_state),
-    }
-    return ocp.args.StandardSave(state)
+    def _check_anomalies(self, streak_dev, anoms_dev, seen_anoms: int,
+                         at_step: int) -> None:
+        """Host-side read of the in-graph anomaly carries: sync the
+        skipped-step counter and abort on a streak past the limit."""
+        cfg = self.config
+        streak_val = int(streak_dev)
+        anoms_val = int(anoms_dev)
+        if anoms_val > seen_anoms:
+            self.telemetry.counter("train.anomalies_skipped").inc(
+                anoms_val - seen_anoms
+            )
+            self.recorder.record(
+                "anomaly", tick=at_step, streak=streak_val,
+                skipped_total=anoms_val,
+            )
+            _log.warning(
+                "step %d: %d anomalous gradient step(s) quarantined "
+                "(streak %d) — params/optimizer not advanced",
+                at_step, anoms_val - seen_anoms, streak_val,
+            )
+        if cfg.anomaly_limit and streak_val >= cfg.anomaly_limit:
+            raise FriendlyError(
+                f"{streak_val} consecutive anomalous gradient steps "
+                f"(non-finite or exploding grad_norm) at step {at_step}; "
+                f"aborting after anomaly_limit={cfg.anomaly_limit}. The "
+                "quarantine kept params and optimizer state at their "
+                "last healthy values — inspect the dumped flight "
+                "recorder and the train.data pipeline"
+            )

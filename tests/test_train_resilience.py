@@ -1,0 +1,425 @@
+"""Fault-tolerant training drills (ISSUE 14 tentpole).
+
+The contract under test (docs/TRAINING.md "Failure semantics"): the
+seeded fault harness (``core/faults.py``) drives the trainer's four
+``train.*`` hook sites and the trainer answers with — bit-exact resume
+from the atomically committed checkpoint after a ``kill`` (single
+device AND 2x2 data x model mesh; a torn checkpoint write keeps the
+previous checkpoint restorable); in-graph grad-anomaly QUARANTINE (a
+NaN batch skips the update without advancing params or the optimizer
+step count, is counted, and N consecutive bad steps abort with a
+flight-recorder dump); capped deterministic retry for transients that
+is invisible to the final params; graceful DEGRADATION down the
+power-of-two gradient-accumulation ladder on RESOURCE_EXHAUSTED; and
+elastic resume at a reduced data-parallel width. The train ->
+checkpoint -> ServeEngine round-trip closes the loop: a checkpoint
+written by the trainer serves bit-identically to ``generate()`` under
+the serving compile pins.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.faults import (
+    EngineKilled,
+    Fault,
+    FaultInjector,
+    parse_fault_spec,
+)
+from mmlspark_tpu.models import build_model, generate
+from mmlspark_tpu.train.resilience import (
+    AtomicCheckpointStore,
+    next_accum_rung,
+)
+from mmlspark_tpu.train.trainer import (
+    SPMDTrainer,
+    TrainConfig,
+    _make_optimizer,
+    _merge_variables,
+    _split_variables,
+)
+
+
+def _two_blob_data(n=96, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    x = np.concatenate(
+        [rng.normal(-1.5, 1.0, (half, d)), rng.normal(1.5, 1.0, (half, d))]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(half), np.ones(half)]).astype(np.int32)
+    perm = rng.permutation(n)
+    return x[perm], y[perm]
+
+
+def _cfg(**kw):
+    base = dict(epochs=2, batch_size=32, learning_rate=1e-2,
+                shuffle=False, log_every=1, retry_backoff_s=0.0)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- hook-site registration (satellite: unknown sites error usefully) ------
+
+
+def test_unknown_site_error_lists_train_sites():
+    with pytest.raises(FriendlyError, match=r"train\.step"):
+        Fault("train.bogus", "kill")
+    with pytest.raises(FriendlyError, match=r"train\.checkpoint"):
+        FaultInjector(site_rates={"train.bogus": {"kill": 1.0}})
+
+
+def test_parse_fault_spec_accepts_and_lists_train_sites():
+    inj = parse_fault_spec(
+        "seed=3,train.step:transient=0.5,train.data:poison=0.25,"
+        "train.checkpoint:kill=0.1,train.restore:transient=0.1"
+    )
+    assert set(inj.site_rates) == {
+        "train.step", "train.data", "train.checkpoint", "train.restore",
+    }
+    with pytest.raises(FriendlyError, match=r"train\.restore"):
+        parse_fault_spec("train.bogus:kill=1.0")
+
+
+def test_next_accum_rung_power_of_two_ladder():
+    assert next_accum_rung(1, batch=32, n_data=8) == 2
+    assert next_accum_rung(2, batch=32, n_data=8) == 4
+    assert next_accum_rung(4, batch=32, n_data=8) is None  # 1 row/shard
+    assert next_accum_rung(1, batch=8, n_data=8) is None
+
+
+# -- disabled / inert hooks change nothing ---------------------------------
+
+
+def test_inert_injector_is_bit_identical_to_disabled():
+    """An injector that never fires must not perturb training: the
+    quarantine is in-graph either way, and the hooks are pure host
+    checks — params and history come out bit-identical."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    t_off = SPMDTrainer(g, _cfg())
+    v_off = t_off.train(x, y)
+    t_on = SPMDTrainer(g, _cfg(), faults=FaultInjector([]))
+    v_on = t_on.train(x, y)
+    _assert_trees_equal(v_off, v_on)
+    assert [h["loss"] for h in t_off.history] == \
+        [h["loss"] for h in t_on.history]
+
+
+# -- kill -> bit-exact resume ----------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "mesh_axes", [None, {"data": 2, "model": 2}],
+    ids=["default-mesh", "2x2-data-model"],
+)
+def test_kill_and_resume_bit_exact(tmp_path, mesh_axes):
+    """The headline drill: crash at step 3 of 6, resume, and the final
+    params AND the stitched loss curve are bit-identical to a run that
+    never crashed."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    t_full = SPMDTrainer(g, _cfg(mesh_axes=mesh_axes))
+    v_full = t_full.train(x, y)
+
+    ck = dict(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+              mesh_axes=mesh_axes)
+    crashed = SPMDTrainer(
+        g, _cfg(**ck),
+        faults=FaultInjector([Fault("train.step", "kill", tick=3)]),
+    )
+    with pytest.raises(EngineKilled):
+        crashed.train(x, y)
+    assert [h["step"] for h in crashed.history] == [0, 1, 2]
+
+    resumed = SPMDTrainer(g, _cfg(**ck))
+    v_res = resumed.train(x, y)
+    assert [h["step"] for h in resumed.restored_history] == [0, 1, 2]
+    assert [h["step"] for h in resumed.history] == [3, 4, 5]
+    full_curve = [h["loss"] for h in t_full.history]
+    stitched = [h["loss"] for h in
+                resumed.restored_history + resumed.history]
+    np.testing.assert_array_equal(full_curve, stitched)
+    _assert_trees_equal(v_full, v_res)
+
+
+def test_torn_checkpoint_keeps_previous_restorable(tmp_path):
+    """A crash INSIDE the checkpoint write (between payload and
+    manifest commit) must leave the previous checkpoint as latest; the
+    resumed run is still bit-identical to an uninterrupted one."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    v_full = SPMDTrainer(g, _cfg()).train(x, y)
+
+    ck = dict(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
+    crashed = SPMDTrainer(
+        g, _cfg(**ck),
+        faults=FaultInjector([Fault("train.checkpoint", "kill", tick=2)]),
+    )
+    with pytest.raises(EngineKilled):
+        crashed.train(x, y)
+    store = AtomicCheckpointStore(str(tmp_path / "ck"))
+    assert store.steps() == [0, 1]  # step 2's write is torn debris
+    assert store.latest_step() == 1
+    assert not (tmp_path / "ck" / "step-2.json").exists()
+
+    resumed = SPMDTrainer(g, _cfg(**ck))
+    v_res = resumed.train(x, y)
+    assert resumed.history[0]["step"] == 2  # replays exactly one step
+    _assert_trees_equal(v_full, v_res)
+
+
+# -- grad-anomaly quarantine -----------------------------------------------
+
+
+def test_anomaly_skips_update_without_advancing(tmp_path):
+    """One poisoned batch in a one-step run: params, rest, AND the
+    optimizer's own step count must come back exactly at their initial
+    values — the update was skipped, not applied-and-reverted-late —
+    and the skip is counted once."""
+    x, y = _two_blob_data(n=32)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    cfg = _cfg(epochs=1, checkpoint_dir=str(tmp_path / "ck"),
+               checkpoint_every=1)
+    # host copy: the trainer donates the device buffers it is handed
+    init = jax.device_get(g.init(jax.random.PRNGKey(cfg.seed),
+                                 jnp.asarray(x[:1])))
+    tr = SPMDTrainer(
+        g, cfg, faults=FaultInjector([Fault("train.data", "poison",
+                                            tick=0)]),
+    )
+    trained = tr.train(x, y, init_variables=init)
+    _assert_trees_equal(init, trained)
+    assert tr.telemetry.counter("train.anomalies_skipped").value == 1
+    assert [h["step"] for h in tr.history] == [0]  # not double-advanced
+    assert not np.isfinite(tr.history[0]["loss"])
+    assert any(e["name"] == "anomaly" for e in tr.recorder.events())
+
+    # the checkpoint carries the proof: the optimizer step count (the
+    # only integer leaf in adam's state) is still 0, and the anomaly
+    # carries persisted as (streak=1, total=1)
+    p0, r0 = _split_variables(jax.device_get(init))
+    tx = _make_optimizer(cfg, 1)
+    target = {
+        "params": p0, "rest": r0,
+        "opt_state": jax.device_get(tx.init(p0)),
+        "anomaly": {"streak": np.zeros((), np.int32),
+                    "total": np.zeros((), np.int32)},
+    }
+    state, _, step = AtomicCheckpointStore(str(tmp_path / "ck")).restore(
+        target
+    )
+    assert step == 0
+    int_leaves = [
+        leaf for leaf in jax.tree_util.tree_leaves(state["opt_state"])
+        if np.issubdtype(np.asarray(leaf).dtype, np.integer)
+    ]
+    assert int_leaves and all(int(leaf) == 0 for leaf in int_leaves)
+    assert int(state["anomaly"]["streak"]) == 1
+    assert int(state["anomaly"]["total"]) == 1
+
+
+def test_anomaly_streak_aborts_with_recorder_dump(caplog):
+    """N consecutive quarantined steps must abort with a FriendlyError
+    AND dump the flight recorder (the black-box contract)."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    tr = SPMDTrainer(
+        g, _cfg(epochs=1, anomaly_limit=3),
+        faults=FaultInjector([Fault("train.data", "poison", times=10)]),
+    )
+    with caplog.at_level(logging.ERROR, logger="mmlspark_tpu.core.telemetry"):
+        with pytest.raises(FriendlyError, match="consecutive anomalous"):
+            tr.train(x, y)
+    assert "flight recorder dump" in caplog.text
+    anomalies = [e for e in tr.recorder.events() if e["name"] == "anomaly"]
+    assert len(anomalies) == 3
+    assert anomalies[-1]["attrs"]["streak"] == 3
+    assert tr.telemetry.counter("train.anomalies_skipped").value == 3
+
+
+def test_grad_norm_explosion_quarantined():
+    """max_grad_norm turns a finite-but-exploding step into an anomaly:
+    with a sub-noise threshold every step is quarantined, so params
+    never move from init."""
+    x, y = _two_blob_data(n=32)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    cfg = _cfg(epochs=1, max_grad_norm=1e-9, anomaly_limit=0)
+    init = jax.device_get(g.init(jax.random.PRNGKey(cfg.seed),
+                                 jnp.asarray(x[:1])))
+    tr = SPMDTrainer(g, cfg, faults=None)  # quarantine is always in-graph
+    trained = tr.train(x, y, init_variables=init)
+    _assert_trees_equal(init, trained)
+    assert tr.telemetry.counter("train.anomalies_skipped").value == 1
+
+
+# -- transient retry / stall -----------------------------------------------
+
+
+def test_transient_retries_are_invisible_to_results():
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    v_clean = SPMDTrainer(g, _cfg()).train(x, y)
+    inj = FaultInjector(
+        [Fault("train.step", "transient", times=2),
+         Fault("train.data", "transient", times=1),
+         Fault("train.step", "stall", times=1)],
+        stall_s=0.001,
+    )
+    tr = SPMDTrainer(g, _cfg(), faults=inj)
+    v_faulted = tr.train(x, y)
+    _assert_trees_equal(v_clean, v_faulted)
+    assert tr.telemetry.counter("train.retries_total").value == 3
+    assert inj.counts.get("stall") == 1
+    retries = [e for e in tr.recorder.events() if e["name"] == "retry"]
+    assert len(retries) == 3
+
+
+def test_transient_beyond_retry_limit_escapes():
+    from mmlspark_tpu.core.faults import TransientFault
+
+    x, y = _two_blob_data(n=32)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    tr = SPMDTrainer(
+        g, _cfg(epochs=1, retry_limit=2),
+        faults=FaultInjector([Fault("train.step", "transient", times=5)]),
+    )
+    with pytest.raises(TransientFault):
+        tr.train(x, y)
+    assert tr.telemetry.counter("train.retries_total").value == 2
+
+
+# -- RESOURCE_EXHAUSTED -> accumulation ladder -----------------------------
+
+
+def test_oom_degrades_down_accumulation_ladder():
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    tr = SPMDTrainer(
+        g, _cfg(),
+        faults=FaultInjector([Fault("train.step", "oom", tick=1)]),
+    )
+    tr.train(x, y)
+    assert tr.telemetry.gauge("train.grad_accum").value == 2
+    degraded = [e for e in tr.recorder.events() if e["name"] == "degraded"]
+    assert degraded and degraded[0]["attrs"]["grad_accum"] == 2
+    assert [h["step"] for h in tr.history] == [0, 1, 2, 3, 4, 5]
+    assert all(np.isfinite(h["loss"]) for h in tr.history)
+
+
+def test_oom_with_ladder_exhausted_aborts():
+    x, y = _two_blob_data(n=16)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    tr = SPMDTrainer(
+        g, _cfg(epochs=1, batch_size=8),  # 1 row per data shard already
+        faults=FaultInjector([Fault("train.step", "oom", tick=0)]),
+    )
+    with pytest.raises(FriendlyError, match="ladder"):
+        tr.train(x, y)
+
+
+# -- elastic resume at reduced data-parallel width -------------------------
+
+
+def test_elastic_resume_at_reduced_data_width(tmp_path):
+    """Crash at data=4, resume at data=2: the deterministic data order
+    (same global batch, same shuffle seed) lets the narrower mesh pick
+    up at the exact step the checkpoint committed."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    ck = dict(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
+    crashed = SPMDTrainer(
+        g, _cfg(mesh_axes={"data": 4}, **ck),
+        faults=FaultInjector([Fault("train.step", "kill", tick=3)]),
+    )
+    with pytest.raises(EngineKilled):
+        crashed.train(x, y)
+
+    resumed = SPMDTrainer(g, _cfg(mesh_axes={"data": 2}, **ck))
+    v = resumed.train(x, y)
+    assert [h["step"] for h in resumed.history] == [3, 4, 5]
+    assert all(np.isfinite(h["loss"]) for h in resumed.history)
+    for leaf in jax.tree_util.tree_leaves(v):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_elastic_resume_rejects_incompatible_geometry(tmp_path):
+    """A resume whose batch rounding changes steps_per_epoch would
+    silently replay or skip data — it must be refused instead."""
+    x, y = _two_blob_data(n=96)
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    ck = dict(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
+    SPMDTrainer(g, _cfg(epochs=1, batch_size=32, **ck)).train(x, y)
+    bad = SPMDTrainer(g, _cfg(epochs=2, batch_size=48, **ck))
+    with pytest.raises(FriendlyError, match="steps_per_epoch"):
+        bad.train(x, y)
+
+
+# -- train -> checkpoint -> serve round-trip -------------------------------
+
+
+def test_train_checkpoint_serve_roundtrip(tmp_path):
+    """A checkpoint written by the trainer, restored through the store's
+    own recipe in a 'fresh process' (init-derived target), must serve
+    bit-identically to ``generate()`` over the trained variables —
+    under the serving compile pins."""
+    from mmlspark_tpu.serve import ServeEngine
+    from mmlspark_tpu.testing.compile_guard import serve_compile_guard
+
+    graph = build_model("transformer_lm", vocab_size=8, d_model=32,
+                        heads=2, depth=2, max_len=32)
+    ids = np.repeat(((np.arange(16)[None, :] % 4) + 1), 8, axis=0)
+    ids = ids.astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    cfg = _cfg(epochs=2, batch_size=4, learning_rate=5e-2, log_every=100,
+               mesh_axes={"data": 2},
+               checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=0)
+    trainer = SPMDTrainer(graph, cfg)
+    trained = trainer.train(ids, labels)
+
+    # resume target rebuilt from scratch — nothing reused from the
+    # trainer object, exactly what a respawned process would hold
+    init = graph.init(jax.random.PRNGKey(cfg.seed), jnp.asarray(ids[:1]))
+    p0, r0 = _split_variables(jax.device_get(init))
+    total_steps = 4  # 8 rows / batch 4 x 2 epochs
+    tx = _make_optimizer(cfg, total_steps)
+    target = {
+        "params": p0, "rest": r0,
+        "opt_state": jax.device_get(tx.init(p0)),
+        "anomaly": {"streak": np.zeros((), np.int32),
+                    "total": np.zeros((), np.int32)},
+    }
+    store = AtomicCheckpointStore(str(tmp_path / "ck"))
+    state, meta, step = store.restore(target)
+    assert step == total_steps - 1
+    assert int(meta["steps_per_epoch"]) == 2
+    _assert_trees_equal(
+        state["params"], _split_variables(jax.device_get(trained))[0]
+    )
+
+    variables = _merge_variables(state["params"], state["rest"])
+    prompt = ids[0, :4]
+    ref = np.asarray(
+        generate(graph, trained, prompt[None], 8)
+    )[0]
+    engine = ServeEngine(graph, variables, slots=2, cache_len=32,
+                         decode_block=4)
+    with serve_compile_guard(engine, min_decode=1, min_prefill=1):
+        rid = engine.submit(prompt, max_new_tokens=8)
+        res = engine.run()[rid]
+    assert res.status == "completed"
+    np.testing.assert_array_equal(np.asarray(res.tokens), ref)

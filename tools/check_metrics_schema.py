@@ -27,11 +27,22 @@ A second run at ``--replicas 2`` pins the replicated-serving contract
 the supervisor's recorder/registry (failover/hedge/drain counters in
 the exposition, ``routed`` events in the timeline).
 
+``--train`` runs the TRAINING surface instead: two seeded fault
+drills through the ``train`` CLI (docs/TRAINING.md) pin the trainer's
+metric/event schema — the resilience counters
+(``train.retries_total``, ``train.anomalies_skipped``,
+``train.checkpoints``, ``train.checkpoint_failures``), the step-time
+and loss histograms, the flight-recorder timeline (``step`` /
+``checkpoint`` / ``restore`` / ``anomaly`` / ``retry`` / ``restart``)
+and the ``train_*`` Prometheus exposition.
+
 Exits non-zero with a pointed message on the first violation, so
 ``tools/ci.sh`` catches schema drift before a dashboard does
 (docs/OBSERVABILITY.md). Usage::
 
-    python tools/check_metrics_schema.py
+    python tools/check_metrics_schema.py            # serve surfaces
+    python tools/check_metrics_schema.py --disagg   # fleet surface
+    python tools/check_metrics_schema.py --train    # training surface
 """
 
 from __future__ import annotations
@@ -249,6 +260,60 @@ REQUIRED_FLEET_PER_REPLICA_KEYS: dict[str, tuple] = {
 #: any of these breaks trace.json's tick/dispatch tracks, so the gate
 #: pins their presence in a demo run's events.jsonl
 REQUIRED_EVENT_NAMES = {"dispatch", "tick"}
+
+# the train CLI's one-line contract (docs/TRAINING.md "Observability"):
+# SPMDTrainer's registry flattened by MetricRegistry.to_dict() plus the
+# demo's run summary. Counters are ints; histogram leaves are the
+# _count/_mean/_p50/_p95/_p99 five-key spelling the serve surface uses.
+REQUIRED_TRAIN_KEYS: dict[str, tuple] = {
+    # resilience counters — the keys the drill dashboards key on
+    "train.retries_total": (int,),
+    "train.anomalies_skipped": (int,),
+    "train.checkpoints": (int,),
+    "train.checkpoint_failures": (int,),
+    "train.faults_injected_total": (int,),
+    # the degrade ladder's current rung
+    "train.grad_accum": NUM,
+    # step-time / throughput / loss / grad-norm histograms
+    "train.step_ms_count": (int,),
+    "train.step_ms_mean": NUM,
+    "train.step_ms_p50": NUM,
+    "train.step_ms_p95": NUM,
+    "train.step_ms_p99": NUM,
+    "train.tokens_per_sec_count": (int,),
+    "train.tokens_per_sec_mean": NUM,
+    "train.tokens_per_sec_p50": NUM,
+    "train.tokens_per_sec_p95": NUM,
+    "train.tokens_per_sec_p99": NUM,
+    "train.loss_count": (int,),
+    "train.loss_mean": NUM,
+    "train.loss_p50": NUM,
+    "train.loss_p95": NUM,
+    "train.loss_p99": NUM,
+    "train.grad_norm_count": (int,),
+    "train.grad_norm_mean": NUM,
+    "train.grad_norm_p50": NUM,
+    "train.grad_norm_p95": NUM,
+    "train.grad_norm_p99": NUM,
+    # run summary
+    "steps_total": (int,),
+    "final_loss": NUM,
+    "restarts": (int,),
+    "epochs": (int,),
+    "batch_size": (int,),
+    "history_len": (int,),
+    "checkpoint_steps": (list,),
+    "checkpoint_dir": (str,),
+    "model_config": (dict,),
+    "faults_injected": (dict,),
+}
+
+# timeline names the trainer emits (docs/TRAINING.md): the drill run
+# must show the quarantine/retry plane, the kill run the resume plane.
+REQUIRED_TRAIN_DRILL_EVENTS = {
+    "step", "checkpoint", "anomaly", "retry", "fault_injected",
+}
+REQUIRED_TRAIN_KILL_EVENTS = {"step", "checkpoint", "restore", "restart"}
 
 
 def fail(msg: str) -> "None":
@@ -653,6 +718,169 @@ def check_int8_mode(env: dict, repo: str) -> None:
         )
 
 
+def _run_train_demo(env: dict, repo: str, tdir: str, faults: str,
+                    label: str) -> tuple[dict, set]:
+    """One ``train`` CLI run at smoke scale with an injected-fault
+    spec; returns (metrics dict, event names seen). The injector's
+    stream is seeded, so the same spec fires the same faults every
+    run — the gate can pin which planes lit up."""
+    cmd = [
+        sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4",
+        "train", "--epochs", "2", "--samples", "96",
+        "--batch-size", "32", "--seed", "0", "--checkpoint-every", "1",
+        "--anomaly-limit", "8", "--faults", faults,
+        "--telemetry-dir", tdir,
+        "--checkpoint-dir", os.path.join(tdir, "ck"),
+    ]
+    res = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=300,
+        env=env, cwd=repo,
+    )
+    if res.returncode != 0:
+        fail(f"train ({label}) exited {res.returncode}:\n{res.stderr}")
+    out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+    if len(out_lines) != 1:
+        fail(
+            f"train ({label}) stdout must be exactly ONE JSON line, "
+            f"got {len(out_lines)}:\n{res.stdout}"
+        )
+    try:
+        md = json.loads(out_lines[0])
+    except json.JSONDecodeError as e:
+        fail(f"train ({label}) stdout line is not JSON: {e}")
+    for key, types in REQUIRED_TRAIN_KEYS.items():
+        if key not in md:
+            fail(f"train ({label}) stdout: missing key {key!r}")
+        if not isinstance(md[key], types):
+            fail(
+                f"train ({label}) stdout: key {key!r} has type "
+                f"{type(md[key]).__name__}, expected one of "
+                f"{[t.__name__ for t in types]} (value: {md[key]!r})"
+            )
+    mpath = os.path.join(tdir, "metrics.json")
+    if not os.path.exists(mpath):
+        fail(f"train ({label}) --telemetry-dir produced no metrics.json")
+    persisted = json.load(open(mpath, encoding="utf-8"))
+    missing = set(REQUIRED_TRAIN_KEYS) - set(persisted)
+    if missing:
+        fail(f"train ({label}) metrics.json lacks keys {missing}")
+    epath = os.path.join(tdir, "events.jsonl")
+    try:
+        lines = open(epath, encoding="utf-8").read().splitlines()
+    except OSError as e:
+        fail(f"train ({label}) events.jsonl unreadable: {e}")
+    if not lines:
+        fail(f"train ({label}) events.jsonl is empty")
+    try:
+        header = json.loads(lines[0])
+    except json.JSONDecodeError as e:
+        fail(f"train ({label}) events.jsonl header is not JSON: {e}")
+    if header.get("header") != "flight_recorder":
+        fail(f"train ({label}) events.jsonl must open with the dump "
+             f"header, got {header}")
+    if not isinstance(header.get("t0_unix"), (int, float)):
+        fail(f"train ({label}) dump header lacks numeric t0_unix: "
+             f"{header}")
+    names: set = set()
+    for i, line in enumerate(lines[1:], 2):
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError as e:
+            fail(f"train ({label}) events.jsonl line {i} is not "
+                 f"JSON: {e}")
+        if "t" not in ev or "name" not in ev:
+            fail(f"train ({label}) events.jsonl line {i} lacks "
+                 f"'t'/'name': {ev}")
+        names.add(ev["name"])
+    # step accounting must hold across faults: 96 samples / 32 batch
+    # x 2 epochs = 6 optimizer steps, every one of them exactly once
+    if md["steps_total"] != 6:
+        fail(
+            f"train ({label}): the smoke geometry runs exactly 6 "
+            f"steps, got steps_total={md['steps_total']} (a crash or "
+            "retry double-advanced or lost a step)"
+        )
+    ck = md["checkpoint_steps"]
+    if not ck or ck != sorted(ck) or not all(
+            isinstance(s, int) for s in ck):
+        fail(f"train ({label}): checkpoint_steps must be a non-empty "
+             f"ascending int list, got {ck!r}")
+    if ck[-1] != 5:
+        fail(f"train ({label}): the final committed checkpoint must "
+             f"be step 5, got {ck[-1]}")
+    return md, names
+
+
+def check_train_mode(env: dict, repo: str) -> None:
+    """Training telemetry gate (``--train``): two seeded fault drills
+    through the real ``train`` CLI (docs/TRAINING.md). The drill run
+    pressures the quarantine/retry plane (``train.data`` poison +
+    ``train.step`` transients); the kill run crashes the trainer
+    mid-epoch and pins the resume plane (``restore``/``restart``
+    events, no lost or double-counted steps). Both pin the full
+    ``REQUIRED_TRAIN_KEYS`` stdout/metrics.json schema."""
+    with tempfile.TemporaryDirectory() as tdir:
+        md, names = _run_train_demo(
+            env, repo, tdir,
+            "seed=5,train.step:kill=0.12,train.step:transient=0.10,"
+            "train.data:poison=0.10",
+            "drill",
+        )
+        missing = REQUIRED_TRAIN_DRILL_EVENTS - names
+        if missing:
+            fail(f"train (drill) events.jsonl lacks {missing} "
+                 f"(names seen: {sorted(names)})")
+        if md["train.anomalies_skipped"] < 1:
+            fail("train (drill): the poison spec must quarantine at "
+                 "least one anomalous step")
+        if md["train.retries_total"] < 1:
+            fail("train (drill): the transient spec must drive at "
+                 "least one retry")
+        if md["train.faults_injected_total"] != sum(
+                md["faults_injected"].values()):
+            fail(
+                "train (drill): train.faults_injected_total "
+                f"({md['train.faults_injected_total']}) disagrees with "
+                f"the injector's counts ({md['faults_injected']})"
+            )
+        ppath = os.path.join(tdir, "metrics.prom")
+        if not os.path.exists(ppath):
+            fail("train (drill) --telemetry-dir produced no "
+                 "metrics.prom")
+        prom = open(ppath, encoding="utf-8").read()
+        for needle in ("train_retries_total", "train_anomalies_skipped_total",
+                       "train_checkpoints_total",
+                       "train_checkpoint_failures_total",
+                       "train_faults_injected_total",
+                       "# TYPE train_grad_accum gauge",
+                       "train_step_ms_bucket{", "train_loss_sum",
+                       'le="+Inf"'):
+            if needle not in prom:
+                fail(f"train (drill) metrics.prom lacks {needle!r}")
+        if "_total_total" in prom:
+            fail("train (drill) metrics.prom double-suffixed a "
+                 "counter name")
+    with tempfile.TemporaryDirectory() as tdir:
+        md2, names2 = _run_train_demo(
+            env, repo, tdir, "seed=5,train.step:kill=0.15", "kill",
+        )
+        missing = REQUIRED_TRAIN_KILL_EVENTS - names2
+        if missing:
+            fail(f"train (kill) events.jsonl lacks {missing} "
+                 f"(names seen: {sorted(names2)})")
+        if md2["restarts"] < 1:
+            fail("train (kill): the kill spec must crash the trainer "
+                 "at least once")
+    print(
+        f"check_metrics_schema: OK — --train line carries "
+        f"{len(REQUIRED_TRAIN_KEYS)} keys on both surfaces; drill run "
+        f"quarantined {md['train.anomalies_skipped']} step(s) and "
+        f"retried {md['train.retries_total']} transient(s); kill run "
+        f"survived {md2['restarts']} crash(es) with all 6 steps "
+        f"accounted for; train_* counters present in the exposition"
+    )
+
+
 def main() -> None:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -662,6 +890,10 @@ def main() -> None:
         # the disagg gate in tools/ci.sh runs this surface on its own
         # (the default run keeps the historical three-surface sweep)
         check_disagg_mode(env, repo)
+        return
+    if "--train" in sys.argv[1:]:
+        # the train-resilience gate likewise runs on its own
+        check_train_mode(env, repo)
         return
     with tempfile.TemporaryDirectory() as tdir:
         # --mesh makes the run exercise the SHARDED engine, so the gate
