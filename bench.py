@@ -123,6 +123,7 @@ _GROUPS = {
     "serve_int8": ("serve_int8",),
     "serve_supervisor": ("serve_supervisor",),
     "serve_disagg": ("serve_disagg",),
+    "serve_multimodel": ("serve_multimodel",),
     "train_resilience": ("train_resilience",),
 }
 
@@ -1480,6 +1481,145 @@ def bench_serve_disagg(jax) -> dict:
     return {"serve_disagg": out}
 
 
+def bench_serve_multimodel(jax) -> dict:
+    """Multi-model serving figures (docs/SERVING.md "Multi-model
+    serving"), at EQUAL device budget vs dedicated engines:
+
+    - ``lm_ttft_p99_ms_mixed`` / ``clf_ttft_p99_ms_mixed`` vs the
+      ``*_dedicated`` twins: the SAME interleaved arrival schedule
+      through one ``MultiModelEngine`` (device_budget=2) hosting an LM
+      plus a stateless classifier, and through a lone ``ServeEngine``
+      + a lone ``BatchDeployment`` each owning its own dispatch slot.
+      The ratio prices the round-robin scheduler's interleaving tax —
+      what co-hosting the zoo costs each model's tail;
+    - ``lm_tokens_per_sec_mixed`` / ``clf_examples_per_sec_mixed``
+      (+ dedicated twins): throughput per model on the mixed schedule —
+      the regression-gated ``per_sec`` leaves for this group."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve import ServeEngine
+    from mmlspark_tpu.serve.multimodel import (
+        BatchDeployment,
+        MultiModelEngine,
+    )
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 32, 2, 2)
+    )
+    slots, n_req, max_new = (8, 16, 33) if full else (4, 8, 9)
+    p = 8
+    cache_len = 128 if full else 32
+    clf_dim, clf_batch = (256, 8) if full else (32, 4)
+    lm = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len,
+    )
+    lmv = lm.init(jax.random.PRNGKey(0), jnp.zeros((1, p), jnp.int32))
+    clf = build_model("mlp", num_outputs=10, hidden=(clf_dim, clf_dim))
+    clfv = clf.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, clf_dim), jnp.float32)
+    )
+    rng = np.random.default_rng(23)
+    prompts = [
+        row.astype(np.int32)
+        for row in rng.integers(0, vocab, size=(n_req, p))
+    ]
+    examples = [
+        rng.normal(size=(clf_dim,)).astype(np.float32)
+        for _ in range(n_req)
+    ]
+    lm_kwargs = dict(slots=slots, cache_len=cache_len, max_queue=n_req,
+                     decode_block=8, retry_backoff_s=0.0)
+
+    def drive_mixed(eng) -> None:
+        """Interleaved arrivals: one LM prompt + one classifier example
+        per tick until both streams drain."""
+        it_p, it_x = iter(prompts), iter(examples)
+        pending = True
+        while pending or eng.busy:
+            pr, x = next(it_p, None), next(it_x, None)
+            pending = pr is not None or x is not None
+            if pr is not None:
+                eng.submit(pr, model="lm", max_new_tokens=max_new)
+            if x is not None:
+                eng.submit(x, model="clf")
+            eng.step()
+        eng.run()
+
+    def drive_dedicated(lm_eng, clf_dep) -> None:
+        """The same schedule, each model on its own engine — both
+        stepped every tick (2 dispatch slots, same as the mixed
+        budget)."""
+        it_p, it_x = iter(prompts), iter(examples)
+        pending = True
+        while pending or lm_eng.busy or clf_dep.busy:
+            pr, x = next(it_p, None), next(it_x, None)
+            pending = pr is not None or x is not None
+            if pr is not None:
+                lm_eng.submit(pr, max_new_tokens=max_new)
+            if x is not None:
+                clf_dep.submit(x)
+            lm_eng.step()
+            clf_dep.step()
+
+    mixed = MultiModelEngine(device_budget=2)
+    m_lm = mixed.add_lm("lm", lm, lmv, **lm_kwargs)
+    m_clf = mixed.add_batch("clf", clf, clfv, max_batch=clf_batch,
+                            max_queue=n_req)
+    ded_lm = ServeEngine(lm, lmv, **lm_kwargs)
+    ded_clf = BatchDeployment(clf, clfv, max_batch=clf_batch,
+                              max_queue=n_req)
+    drive_mixed(mixed)  # warm-up: compiles every ladder on both sides
+    drive_dedicated(ded_lm, ded_clf)
+
+    repeats = 5
+    m_secs = d_secs = 0.0
+    m_lm_ttfts, m_clf_ttfts, d_lm_ttfts, d_clf_ttfts = [], [], [], []
+    for _ in range(repeats):
+        marks = (len(m_lm.metrics.ttft_s), len(m_clf.metrics.ttft_s))
+        m_secs += _timed(lambda: drive_mixed(mixed))
+        m_lm_ttfts += [t * 1e3 for t in m_lm.metrics.ttft_s[marks[0]:]]
+        m_clf_ttfts += [t * 1e3 for t in m_clf.metrics.ttft_s[marks[1]:]]
+        marks = (len(ded_lm.metrics.ttft_s), len(ded_clf.metrics.ttft_s))
+        d_secs += _timed(lambda: drive_dedicated(ded_lm, ded_clf))
+        d_lm_ttfts += [t * 1e3 for t in ded_lm.metrics.ttft_s[marks[0]:]]
+        d_clf_ttfts += [
+            t * 1e3 for t in ded_clf.metrics.ttft_s[marks[1]:]
+        ]
+
+    out: dict = {
+        "lm_ttft_p99_ms_mixed": round(
+            float(np.percentile(m_lm_ttfts, 99)), 2),
+        "lm_ttft_p99_ms_dedicated": round(
+            float(np.percentile(d_lm_ttfts, 99)), 2),
+        "clf_ttft_p99_ms_mixed": round(
+            float(np.percentile(m_clf_ttfts, 99)), 2),
+        "clf_ttft_p99_ms_dedicated": round(
+            float(np.percentile(d_clf_ttfts, 99)), 2),
+        "lm_tokens_per_sec_mixed": round(
+            repeats * n_req * max_new / m_secs, 1),
+        "lm_tokens_per_sec_dedicated": round(
+            repeats * n_req * max_new / d_secs, 1),
+        "clf_examples_per_sec_mixed": round(
+            repeats * n_req / m_secs, 1),
+        "clf_examples_per_sec_dedicated": round(
+            repeats * n_req / d_secs, 1),
+        "batch_compile_count": m_clf.batch_compile_count,
+        "num_batch_buckets": m_clf.num_batch_buckets,
+        "model": {"vocab": vocab, "d_model": d_model, "heads": heads,
+                  "depth": depth, "requests": n_req, "prompt": p,
+                  "max_new": max_new, "slots": slots,
+                  "clf_dim": clf_dim, "clf_batch": clf_batch},
+        "timing": ("interleaved LM+classifier schedule per target, "
+                   "warm-up then timed repeats; mixed engine at "
+                   "device_budget=2 vs two dedicated engines (2 "
+                   "dispatch slots each side)"),
+    }
+    return {"serve_multimodel": out}
+
+
 def bench_serve_sharded() -> dict:
     """Mesh-sharded serving scaling sweep (docs/SERVING.md "Sharded
     serving"): the SAME synthetic-traffic demo as the ``serve`` group,
@@ -2145,6 +2285,7 @@ def run(attempt: int) -> dict:
         "serve_int8": lambda: bench_serve_int8(jax),
         "serve_supervisor": lambda: bench_serve_supervisor(jax),
         "serve_disagg": lambda: bench_serve_disagg(jax),
+        "serve_multimodel": lambda: bench_serve_multimodel(jax),
         "train_resilience": lambda: bench_train_resilience(jax),
         "int8_serving": lambda: bench_int8_serving(jax, jnp),
         "resnet50": lambda: bench_resnet50(jax, jnp),

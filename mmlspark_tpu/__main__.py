@@ -94,6 +94,8 @@ def cmd_serve(args) -> int:
         prefill_replicas=args.prefill_replicas,
         decode_replicas=args.decode_replicas,
         autoscale=args.autoscale or None,
+        models=args.models or None,
+        device_budget=args.device_budget,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -362,6 +364,26 @@ def main(argv: list[str] | None = None) -> int:
         "slo_burn_ticks=3,idle_ticks=8' — scale-up draws from the "
         "parked budget (max minus baseline), scale-down drains idle "
         "replicas back to it (docs/SERVING.md 'Disaggregated fleet')",
+    )
+    sp.add_argument(
+        "--models", default="", metavar="SPEC",
+        help="serve SEVERAL named deployments through one "
+        "MultiModelEngine: ';'-separated 'name=arch' entries with "
+        "':key=value' fields, e.g. 'lm=transformer_lm:slots=4;"
+        "clf=mlp:max_batch=8;ox=onnx:path=m.onnx' — causal graphs get "
+        "stateful LM-decode engines (slots/cache_len/decode_block), "
+        "everything else stateless power-of-two-bucketed batch "
+        "deployments (max_batch); per-entry 'slo=' specs spell ',' as "
+        "'+'. The JSON line becomes the engine's metrics_dict: totals "
+        "plus one nested dict per model and the shared registry's "
+        "model{name}.serve.* keys (docs/SERVING.md 'Multi-model "
+        "serving')",
+    )
+    sp.add_argument(
+        "--device-budget", type=int, default=None, metavar="B",
+        help="with --models: deployments stepped per engine tick "
+        "(round-robin over the zoo; default: all with queued work) — "
+        "the knob the fairness guarantee is stated against",
     )
     sp.set_defaults(fn=cmd_serve)
 

@@ -77,6 +77,12 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 #: cross-replica KV hand-off payload (serve/fleet.py): a fault there
 #: models a lost/corrupt hand-off, and the engine falls back to a full
 #: local prefill so the request still completes bit-identically.
+#: ``serve.batch`` fires before each STATELESS batch dispatch of a
+#: multi-model deployment (serve/multimodel.py): transients retry with
+#: the same capped deterministic backoff as decode, ``oom`` halves the
+#: deployment's batch admission cap (graceful degradation down the
+#: batch-bucket ladder — no new programs), and retry exhaustion
+#: quarantines the whole batch as ``"failed"``.
 #: The four ``train.*`` sites are the SPMD trainer's hook points
 #: (train/trainer.py, docs/TRAINING.md): ``train.step`` fires before
 #: each optimizer-step dispatch (transients retry with deterministic
@@ -91,7 +97,7 @@ from mmlspark_tpu.core.exceptions import FriendlyError
 #: reads the store.
 SITES = (
     "serve.prefill", "serve.decode", "serve.device_get",
-    "serve.snapshot", "serve.health", "serve.handoff",
+    "serve.snapshot", "serve.health", "serve.handoff", "serve.batch",
     "train.step", "train.data", "train.checkpoint", "train.restore",
 )
 #: fault kinds fire() raises/sleeps for, in rate-table draw order

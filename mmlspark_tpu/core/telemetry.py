@@ -331,6 +331,55 @@ def _prom_num(value: float) -> str:
     return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
 
 
+class NamespacedRegistry:
+    """A prefixing view over a shared :class:`MetricRegistry`.
+
+    Every ``counter``/``gauge``/``histogram`` name is prefixed with
+    ``namespace`` before reaching the inner registry, so N subsystems
+    can share ONE registry — and therefore one Prometheus exposition —
+    with zero name collisions. Unlike :class:`ServeMetrics`'s
+    ``namespace=`` argument (which prefixes only the ``serve.*`` names
+    it creates itself), this view also covers metrics that third
+    parties register against the handed-in registry (``perf.*`` from
+    PerfAnalytics, ``slo.*`` from SloMonitor, retrace counters) — the
+    mechanism the multi-model engine uses to give every deployment its
+    ``model{name}.``-prefixed metric tree (serve/multimodel.py).
+
+    Read-side methods (``to_dict``/``to_prometheus``/``snapshot``)
+    delegate to the WHOLE inner registry: any view is a handle on the
+    one shared exposition.
+    """
+
+    def __init__(self, inner: MetricRegistry, namespace: str):
+        self._inner = inner
+        self.namespace = namespace
+
+    def counter(self, name: str) -> Counter:
+        return self._inner.counter(f"{self.namespace}{name}")
+
+    def gauge(self, name: str) -> Gauge:
+        return self._inner.gauge(f"{self.namespace}{name}")
+
+    def histogram(self, name: str, **kwargs) -> Histogram:
+        return self._inner.histogram(f"{self.namespace}{name}", **kwargs)
+
+    def get(self, name: str):
+        return self._inner.get(f"{self.namespace}{name}")
+
+    def names(self) -> list[str]:
+        return self._inner.names()
+
+    def to_dict(self) -> dict:
+        return self._inner.to_dict()
+
+    def to_prometheus(self) -> str:
+        return self._inner.to_prometheus()
+
+    def snapshot(self, model: str | None = None,
+                 group: str | None = None):
+        return self._inner.snapshot(model=model, group=group)
+
+
 _DEFAULT_REGISTRY = MetricRegistry()
 
 

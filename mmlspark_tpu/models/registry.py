@@ -27,8 +27,14 @@ def register_model(name: str):
 def build_model(name: str, **config: Any) -> NamedGraph:
     _ensure_loaded()
     if name not in _BUILDERS:
+        import difflib
+
+        hint = difflib.get_close_matches(name, sorted(_BUILDERS), n=1)
+        suggest = f"; did you mean '{hint[0]}'?" if hint else ""
         raise FriendlyError(
-            f"unknown model '{name}'; registered: {sorted(_BUILDERS)}"
+            f"unknown model '{name}'; registered: "
+            f"{sorted(_BUILDERS)}{suggest} (foreign graphs load via "
+            "name 'onnx' with path=<file.onnx>)"
         )
     return _BUILDERS[name](**config)
 

@@ -184,8 +184,20 @@ class ModelDownloader:
                 with open(dst, "wb") as f:
                     f.write(self.remote._read(schema.uri))
         if not self._verify(schema):
+            # a torn/corrupt payload must NOT linger: a later
+            # download_by_name would find the cached bytes, re-hash
+            # them, and re-raise forever instead of re-fetching
+            actual = (
+                _sha256_path(dst) if os.path.exists(dst) else "<missing>"
+            )
+            if os.path.isdir(dst):
+                shutil.rmtree(dst, ignore_errors=True)
+            elif os.path.exists(dst):
+                os.remove(dst)
             raise FriendlyError(
-                f"sha256 mismatch for model '{name}' (corrupt download)"
+                f"sha256 mismatch for model '{name}' (corrupt "
+                f"download): expected {schema.hash}, got {actual}; "
+                "the partial payload was deleted — retry the download"
             )
         with open(os.path.join(self.local_repo, f"{schema.name}.meta"), "w") as f:
             f.write(schema.to_json())

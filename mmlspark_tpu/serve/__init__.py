@@ -30,6 +30,15 @@ a cross-replica hand-off plane (the ``serve.handoff`` fault site), a
 fleet-wide prefix index makes any replica's completed prefill every
 replica's cache hit, and an :class:`~mmlspark_tpu.serve.fleet.AutoscalePolicy`
 grows/shrinks each role elastically from a parked device budget.
+
+For MULTI-MODEL serving, :class:`~mmlspark_tpu.serve.multimodel.
+MultiModelEngine` (docs/SERVING.md "Multi-model serving") hosts several
+named deployments — stateful LM-decode engines next to stateless
+power-of-two-bucketed batch deployments over any non-causal
+``build_model`` graph (ONNX-imported included) — behind one
+``submit(model=...)`` facade with per-model admission/SLOs/telemetry
+namespaces, a round-robin device budget, and the ``serve.batch`` fault
+site covering stateless dispatches.
 """
 
 from mmlspark_tpu.core.faults import (  # noqa: F401
@@ -52,6 +61,13 @@ from mmlspark_tpu.serve.fleet import (  # noqa: F401
     parse_autoscale_spec,
 )
 from mmlspark_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from mmlspark_tpu.serve.multimodel import (  # noqa: F401
+    BatchDeployment,
+    BatchResult,
+    MultiModelEngine,
+    engine_from_spec,
+    parse_models_spec,
+)
 from mmlspark_tpu.serve.scheduler import (  # noqa: F401
     ContinuousBatchScheduler,
     RequestResult,

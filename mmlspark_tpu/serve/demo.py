@@ -45,7 +45,9 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              disagg: bool = False,
              prefill_replicas: int = 1,
              decode_replicas: int = 1,
-             autoscale: str | None = None) -> dict:
+             autoscale: str | None = None,
+             models: str | None = None,
+             device_budget: int | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line. With ``replicas > 1`` the loop drives
     a :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet` instead of a
@@ -62,6 +64,19 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
     from mmlspark_tpu.core.faults import parse_fault_spec
     from mmlspark_tpu.models import build_model
     from mmlspark_tpu.serve.engine import ServeEngine
+
+    if models:
+        # --models SPEC -> the multi-model engine (docs/SERVING.md
+        # "Multi-model serving"): one deployment per spec entry, LM and
+        # batch traffic interleaved under one device budget
+        return _run_multimodel_demo(
+            models, n_requests=n_requests,
+            max_new_tokens=max_new_tokens,
+            arrivals_per_tick=arrivals_per_tick, seed=seed,
+            device_budget=device_budget,
+            injector=parse_fault_spec(faults) if faults else None,
+            telemetry_dir=telemetry_dir, trace_out=trace_out,
+        )
 
     graph = build_model(
         "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
@@ -180,4 +195,88 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
 
         export_chrome_trace(recorder, path=trace_out,
                             extra_meta={"model": graph.name})
+    return out
+
+
+def _run_multimodel_demo(spec: str, *, n_requests: int,
+                         max_new_tokens: int, arrivals_per_tick: int,
+                         seed: int, device_budget: int | None,
+                         injector, telemetry_dir: str | None,
+                         trace_out: str | None) -> dict:
+    """The ``--models`` body: spec -> MultiModelEngine, then a
+    deterministic interleaved arrival schedule — ``n_requests`` per
+    deployment, token prompts for LM deployments and float feature
+    examples for batch deployments, round-robin across models so every
+    queue stays contended. One JSON line out: the engine's
+    ``metrics_dict`` (per-model nested dicts + the shared registry's
+    ``model{name}.serve.*`` flat keys)."""
+    from mmlspark_tpu.serve.engine import ServeEngine
+    from mmlspark_tpu.serve.multimodel import engine_from_spec
+
+    engine = engine_from_spec(
+        spec, device_budget=device_budget, faults=injector, seed=seed,
+    )
+    rng = np.random.default_rng(seed)
+    streams: dict[str, list] = {}
+    for name in engine.models:
+        dep = engine.deployment(name)
+        reqs = []
+        for _ in range(n_requests):
+            if isinstance(dep, ServeEngine):
+                vocab = int(dep.graph.extra.get("vocab_size", 16))
+                hi = max(5, min(16, dep.cache_len - max_new_tokens))
+                plen = int(rng.integers(4, hi + 1))
+                reqs.append((rng.integers(0, vocab, size=plen),
+                             max_new_tokens))
+            else:
+                shape = tuple(dep.graph.input_shape)
+                reqs.append(
+                    (rng.normal(size=shape).astype(np.float32), None)
+                )
+        streams[name] = reqs
+    arrivals = [
+        (name, *streams[name][i])
+        for i in range(n_requests) for name in engine.models
+    ]
+    submitted = 0
+    results = {}
+    while submitted < len(arrivals) or engine.busy:
+        for _ in range(arrivals_per_tick):
+            if submitted < len(arrivals):
+                name, x, budget = arrivals[submitted]
+                if budget is None:
+                    engine.submit(x, model=name)
+                else:
+                    engine.submit(x, model=name, max_new_tokens=budget)
+                submitted += 1
+        for res in engine.step():
+            results[res.id] = res
+    out = engine.metrics_dict()
+    out.update(
+        n_requests=n_requests,
+        arrivals_per_tick=arrivals_per_tick,
+        max_new_tokens=max_new_tokens,
+        models_spec=spec,
+    )
+    if telemetry_dir:
+        from mmlspark_tpu.core.perf import export_chrome_trace
+
+        os.makedirs(telemetry_dir, exist_ok=True)
+        engine.recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
+        with open(os.path.join(telemetry_dir, "metrics.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(out, f, indent=1, default=str)
+        export_chrome_trace(
+            engine.recorder,
+            path=os.path.join(telemetry_dir, "trace.json"),
+            extra_meta={"model": "multimodel"},
+        )
+        with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
+                  encoding="utf-8") as f:
+            f.write(engine.to_prometheus())
+    if trace_out:
+        from mmlspark_tpu.core.perf import export_chrome_trace
+
+        export_chrome_trace(engine.recorder, path=trace_out,
+                            extra_meta={"model": "multimodel"})
     return out

@@ -124,7 +124,8 @@ class ServeEngine:
                  snapshot_every_ticks: int | None = None,
                  kv_dtype: str = "bf16",
                  quantize_weights: bool = False,
-                 role: str = "both"):
+                 role: str = "both",
+                 registry=None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
                 f"serving needs a causal LM; '{graph.name}' has "
@@ -284,8 +285,13 @@ class ServeEngine:
         #: were parked — the engine refuses further steps (restore
         #: from a snapshot instead)
         self._dead = False
+        # ``registry``: hand the metrics plane a shared (usually
+        # namespaced — core/telemetry.NamespacedRegistry) registry so
+        # several engines' expositions merge collision-free; None (the
+        # default) keeps the engine's registry private as before
         self.metrics = ServeMetrics(
-            graph.name, slots, decode_block=self.decode_block,
+            graph.name, slots, registry=registry,
+            decode_block=self.decode_block,
             mesh_shape=(
                 {k: int(v) for k, v in self.mesh.shape.items()}
                 if self.mesh is not None else {}

@@ -91,6 +91,9 @@ class _Pending:
     deadline_ticks: int | None
     submit_t: float
     submit_tick: int
+    #: which model this request belongs to (multi-model sets route,
+    #: hedge, and migrate strictly within one model's replicas)
+    model: str | None = None
     copies: list[_Copy] = field(default_factory=list)
     hedged: bool = False
     committed: bool = False
@@ -103,6 +106,9 @@ class _Replica:
     idx: int
     engine: ServeEngine
     state: str = "healthy"
+    #: model this replica serves (None on single-model sets); the
+    #: routing key's first dimension — (model, health, load)
+    model: str | None = None
     #: engine-local request id -> supervisor global id, for every
     #: uncommitted copy routed to this replica
     routed: dict[int, int] = field(default_factory=dict)
@@ -133,9 +139,35 @@ class ReplicaSet:
                  recorder: FlightRecorder | None = None,
                  faults: FaultInjector | None = None,
                  max_failovers: int = 8,
+                 models: dict | None = None,
                  **engine_kwargs):
         if replicas < 1:
             raise FriendlyError(f"replicas must be >= 1, got {replicas}")
+        # multi-model routing dimension (docs/SERVING.md "Multi-model
+        # serving"): ``models`` maps name -> (graph, variables); the
+        # replicas partition round-robin across the models in insertion
+        # order, and every routing decision (submit, hedge, drain
+        # migration, failover rebuild) stays within ONE model's
+        # replicas — the routing key is (model, health, load)
+        if models is not None:
+            if not models:
+                raise FriendlyError(
+                    "models= must name at least one model; for a "
+                    "single-model set pass (graph, variables) "
+                    "positionally instead"
+                )
+            if replicas < len(models):
+                raise FriendlyError(
+                    f"replicas ({replicas}) < models ({len(models)}); "
+                    "every model needs at least one replica to route to"
+                )
+            for mname, pair in models.items():
+                if not (isinstance(pair, tuple) and len(pair) == 2):
+                    raise FriendlyError(
+                        f"models[{mname!r}] must be a (graph, "
+                        "variables) pair"
+                    )
+        self._models = dict(models) if models is not None else None
         if hedge_ms is not None and hedge_ms < 0:
             raise FriendlyError(
                 f"hedge_ms must be >= 0, got {hedge_ms}"
@@ -194,7 +226,8 @@ class ReplicaSet:
         #: gid -> committed RequestResult
         self._results: dict[int, RequestResult] = {}
         self._reps = [
-            _Replica(idx=i, engine=self._build_engine(i))
+            _Replica(idx=i, engine=self._build_engine(i),
+                     model=self._model_name(i))
             for i in range(replicas)
         ]
         now = self._clock()
@@ -204,9 +237,25 @@ class ReplicaSet:
             # first periodic checkpoint still restores (to empty)
             rep.engine.checkpoint()
 
+    def _model_name(self, idx: int) -> str | None:
+        """Which model replica ``idx`` serves: round-robin over the
+        models in insertion order; None on single-model sets."""
+        if self._models is None:
+            return None
+        names = list(self._models)
+        return names[idx % len(names)]
+
+    def _model_src(self, idx: int):
+        """The (graph, variables) a replica builds/restores from."""
+        name = self._model_name(idx)
+        if name is None:
+            return self._graph, self._variables
+        return self._models[name]
+
     def _build_engine(self, idx: int) -> ServeEngine:
+        graph, variables = self._model_src(idx)
         return ServeEngine(
-            self._graph, self._variables, replica=idx,
+            graph, variables, replica=idx,
             faults=self._faults,
             snapshot_every_ticks=self._snapshot_every,
             **self._engine_kwargs,
@@ -217,6 +266,15 @@ class ReplicaSet:
     @property
     def replicas(self) -> int:
         return len(self._reps)
+
+    @property
+    def models(self) -> list[str] | None:
+        """Served model names (insertion order) on a multi-model set;
+        None on classic single-model sets."""
+        return list(self._models) if self._models is not None else None
+
+    def replica_model(self, idx: int) -> str | None:
+        return self._rep(idx).model
 
     @property
     def tick(self) -> int:
@@ -245,13 +303,17 @@ class ReplicaSet:
 
     # -- routing -----------------------------------------------------------
 
-    def _route_order(self, exclude: set[int] = frozenset()) -> list[_Replica]:
-        """Live replicas, best route first: state rank (healthy before
-        degraded before restoring), then load (queue depth + leased
-        slots), then TTFT p99, then index for determinism."""
+    def _route_order(self, exclude: set[int] = frozenset(),
+                     model: str | None = None) -> list[_Replica]:
+        """Live replicas, best route first: model (a request only ever
+        routes within its own model's replicas), then state rank
+        (healthy before degraded before restoring), then load (queue
+        depth + leased slots), then TTFT p99, then index for
+        determinism."""
         live = [
             r for r in self._reps
             if r.state in _LIVE_RANK and r.idx not in exclude
+            and r.model == model
         ]
         return sorted(live, key=lambda r: (
             _LIVE_RANK[r.state],
@@ -264,12 +326,31 @@ class ReplicaSet:
 
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: int | None = None,
-               deadline_ticks: int | None = None) -> int:
+               deadline_ticks: int | None = None,
+               model: str | None = None) -> int:
         """Route one request to the best live replica; returns its
         GLOBAL id (stable across failover/hedging/migration — results
         come back keyed by it). Raises the typed error when every live
-        replica's queue is full (backpressure) or no replica is live."""
-        order = self._route_order()
+        replica's queue is full (backpressure) or no replica is live.
+        Multi-model sets (``models=`` at construction) require
+        ``model=`` — the first routing dimension."""
+        if self._models is not None:
+            if model is None:
+                raise FriendlyError(
+                    "this replica set serves several models — pass "
+                    f"model=<name>; models: {sorted(self._models)}"
+                )
+            if model not in self._models:
+                raise FriendlyError(
+                    f"unknown model '{model}'; models: "
+                    f"{sorted(self._models)}"
+                )
+        elif model is not None:
+            raise FriendlyError(
+                "model= routing needs a multi-model set (pass models= "
+                "to the ReplicaSet constructor)"
+            )
+        order = self._route_order(model=model)
         if not order:
             raise FriendlyError(
                 "no live replica to route to (all drained or "
@@ -295,12 +376,13 @@ class ReplicaSet:
             deadline_ticks=deadline_ticks,
             submit_t=self._clock(),
             submit_tick=self._tick,
+            model=model,
             copies=[_Copy(target.idx, rid)],
         )
         self._open.add(gid)
         self.recorder.record(
             "routed", tick=self._tick, gid=gid, replica=target.idx,
-            rid=rid,
+            rid=rid, model=model,
         )
         return gid
 
@@ -438,8 +520,9 @@ class ReplicaSet:
         snap = old.last_snapshot
         rep.state = "restoring"
         if snap is not None:
+            graph, variables = self._model_src(rep.idx)
             eng = ServeEngine.restore(
-                snap, self._graph, self._variables, replica=rep.idx,
+                snap, graph, variables, replica=rep.idx,
                 faults=self._faults,
                 snapshot_every_ticks=self._snapshot_every,
                 **self._engine_kwargs,
@@ -502,7 +585,9 @@ class ReplicaSet:
             if (now - p.submit_t) * 1e3 < self._hedge_ms:
                 continue
             holder = {c.replica for c in p.copies}
-            order = self._route_order(exclude=holder)
+            # hedge within the request's own model only — a twin on
+            # another model's replica would decode the wrong graph
+            order = self._route_order(exclude=holder, model=p.model)
             target = next(
                 (r for r in order if not r.engine.queue_full), None
             )
@@ -546,13 +631,16 @@ class ReplicaSet:
             "drain", tick=self._tick, replica=replica,
             pending=len(rep.routed),
         )
-        if any(r.state in _LIVE_RANK for r in self._reps):
+        if self._route_order(exclude={rep.idx}, model=rep.model):
             for pay in rep.engine.steal_all():
                 gid = rep.routed.pop(pay["id"], None)
                 if gid is None:
                     continue
-                # re-route per payload: migration load-balances too
-                target = self._route_order(exclude={rep.idx})[0]
+                # re-route per payload: migration load-balances too —
+                # strictly within the drained replica's own model
+                target = self._route_order(
+                    exclude={rep.idx}, model=rep.model,
+                )[0]
                 new_rid = target.engine.adopt(
                     pay["prompt"], prefix=pay["prefix"],
                     max_new_tokens=pay["max_new_tokens"],
@@ -704,6 +792,7 @@ class ReplicaSet:
             wall = max(wall, d["wall_s"] or 0.0)
             per_replica[f"replica{rep.idx}"] = {
                 "state": rep.state,
+                "model": rep.model,
                 "failovers": rep.failovers,
                 "ticks": d["ticks"],
                 "submitted": d["submitted"],

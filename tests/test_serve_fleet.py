@@ -508,9 +508,10 @@ def test_percentile_helpers_zero_on_empty_histograms(lm):
 
 
 def test_unknown_fault_site_error_lists_all_sites():
-    # six serve.* sites plus the trainer's four train.* sites
+    # seven serve.* sites plus the trainer's four train.* sites
     assert "serve.handoff" in SITES and "train.step" in SITES
-    assert len(SITES) == 10
+    assert "serve.batch" in SITES
+    assert len(SITES) == 11
     with pytest.raises(FriendlyError) as ei:
         parse_fault_spec("bogus.site:transient=0.5")
     for site in SITES:

@@ -86,6 +86,48 @@ def test_corrupt_download_detected(tmp_path, remote_repo):
     assert dl._verify(repaired)
 
 
+def test_torn_download_raises_both_hashes_and_deletes_partial(tmp_path):
+    """A download that fails sha256 verification must raise the typed
+    error naming BOTH hashes (expected vs actual) and DELETE the torn
+    payload — a lingering partial would be re-hashed and re-raised
+    forever on every later download_by_name instead of re-fetched."""
+    import json
+
+    remote = str(tmp_path / "remote")
+    payload = tmp_path / "weights.bin"
+    payload.write_bytes(b"trained weights v1")
+    schema = publish_model(remote, "Torn", str(payload))
+    # tamper the published payload AFTER hashing: the fetched bytes can
+    # no longer match the manifest hash (a torn/corrupted transfer)
+    with open(os.path.join(remote, schema.uri), "ab") as f:
+        f.write(b"...torn mid-transfer")
+
+    local = str(tmp_path / "local")
+    dl = ModelDownloader(local, remote=remote)
+    with pytest.raises(FriendlyError) as ei:
+        dl.download_by_name("Torn")
+    msg = str(ei.value)
+    assert schema.hash in msg, "error must name the expected hash"
+    from mmlspark_tpu.models.zoo import _sha256_path
+
+    actual = _sha256_path(os.path.join(remote, schema.uri))
+    assert actual in msg, "error must name the actual hash"
+    # the partial payload is gone and no stale meta was written
+    assert not os.path.exists(dl.local_path(schema))
+    assert not os.path.exists(os.path.join(local, "Torn.meta"))
+    # repairing the remote repairs the client: next download succeeds
+    payload2 = tmp_path / "weights2.bin"
+    payload2.write_bytes(
+        open(os.path.join(remote, schema.uri), "rb").read()
+    )
+    fixed = publish_model(remote, "Torn", str(payload2))
+    got = dl.download_by_name("Torn")
+    assert got.hash == fixed.hash and dl._verify(got)
+    # sanity: the meta JSON on disk round-trips
+    with open(os.path.join(local, "Torn.meta")) as f:
+        assert json.load(f)["hash"] == fixed.hash
+
+
 def test_schema_json_round_trip():
     s = ModelSchema(name="m", uri="m.bin", hash="ab", size=3,
                     layer_names=("a", "z"), input_node="input")

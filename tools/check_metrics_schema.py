@@ -40,9 +40,10 @@ Exits non-zero with a pointed message on the first violation, so
 ``tools/ci.sh`` catches schema drift before a dashboard does
 (docs/OBSERVABILITY.md). Usage::
 
-    python tools/check_metrics_schema.py            # serve surfaces
-    python tools/check_metrics_schema.py --disagg   # fleet surface
-    python tools/check_metrics_schema.py --train    # training surface
+    python tools/check_metrics_schema.py               # serve surfaces
+    python tools/check_metrics_schema.py --disagg      # fleet surface
+    python tools/check_metrics_schema.py --train       # training surface
+    python tools/check_metrics_schema.py --multi-model # model-zoo surface
 """
 
 from __future__ import annotations
@@ -661,6 +662,218 @@ def check_disagg_mode(env: dict, repo: str) -> None:
     )
 
 
+#: engine-level keys on a ``--models`` JSON line
+#: (``MultiModelEngine.metrics_dict()`` + the demo's run config)
+REQUIRED_MULTIMODEL_KEYS = {
+    "multimodel": (bool,),
+    "deployments": (int,),
+    "device_budget": (int, type(None)),
+    "ticks": (int,),
+    "submitted": (int,),
+    "completed": (int,),
+    "failed": (int,),
+    "rejected": (int,),
+    "per_model": (dict,),
+    "registry": (dict,),
+    "models_spec": (str,),
+}
+
+#: keys every per-model nested dict carries regardless of kind
+REQUIRED_MULTIMODEL_MODEL_KEYS = {
+    "kind": (str,),
+    "model": (str,),
+    "submitted": (int,),
+    "completed": (int,),
+    "failed": (int,),
+    "rejected": (int,),
+    "tokens_generated": (int,),
+}
+
+
+def check_multimodel_mode(env: dict, repo: str) -> None:
+    """Multi-model smoke run (``--multi-model``): one engine hosting an
+    LM plus two stateless deployments (one ONNX-imported), driven
+    through the real ``serve --models`` CLI (docs/SERVING.md
+    "Multi-model serving"). Pins the JSON line's engine totals +
+    per-model nested dicts + the shared registry's ``model{name}.``
+    namespaces, the ``model{name}_serve_*`` Prometheus families, and
+    the routed/deployment_added control-plane timeline."""
+    with tempfile.TemporaryDirectory() as tdir:
+        onnx_path = os.path.join(tdir, "clf.onnx")
+        # author the foreign graph the ingestion path imports — a tiny
+        # flax MLP exported to ONNX in its own subprocess (this gate
+        # itself must not import jax)
+        export = subprocess.run(
+            [sys.executable, "-c", (
+                "import jax, jax.numpy as jnp\n"
+                "from mmlspark_tpu.models import build_model\n"
+                "from mmlspark_tpu.models.onnx_export import save_onnx\n"
+                "g = build_model('mlp', num_outputs=3, hidden=(16,))\n"
+                "v = g.init(jax.random.PRNGKey(0), "
+                "jnp.zeros((1, 8), jnp.float32))\n"
+                f"save_onnx(g, v, (1, 8), {onnx_path!r})\n"
+            )],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=repo,
+        )
+        if export.returncode != 0:
+            fail(f"ONNX export helper exited {export.returncode}:\n"
+                 f"{export.stderr}")
+        spec = (
+            "lm=transformer_lm:slots=2:cache_len=32:vocab_size=16:"
+            "d_model=32:heads=2:depth=1:max_len=32;"
+            "clf=mlp:max_batch=4:num_outputs=3:hidden=16x16:"
+            "input_shape=8;"
+            f"ox=onnx:max_batch=4:path={onnx_path}"
+        )
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu",
+            "serve", "--demo", "--models", spec,
+            "--device-budget", "2",
+            "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
+            "--telemetry-dir", tdir,
+        ]
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env=env, cwd=repo,
+        )
+        if res.returncode != 0:
+            fail(f"serve --models exited {res.returncode}:\n"
+                 f"{res.stderr}")
+        out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+        if len(out_lines) != 1:
+            fail(
+                f"--models stdout must be exactly ONE JSON line, got "
+                f"{len(out_lines)}:\n{res.stdout}"
+            )
+        try:
+            md = json.loads(out_lines[0])
+        except json.JSONDecodeError as e:
+            fail(f"--models stdout line is not JSON: {e}")
+        for key, types in REQUIRED_MULTIMODEL_KEYS.items():
+            if key not in md:
+                fail(f"--models stdout: missing key {key!r}")
+            if not isinstance(md[key], types):
+                fail(
+                    f"--models stdout: key {key!r} has type "
+                    f"{type(md[key]).__name__}, expected one of "
+                    f"{[t.__name__ for t in types]} (value: {md[key]!r})"
+                )
+        if md["multimodel"] is not True:
+            fail("--models must report multimodel == true")
+        if md["deployments"] != 3:
+            fail(f"a 3-entry spec must report deployments == 3, got "
+                 f"{md['deployments']}")
+        # the demo submits N_REQUESTS per deployment
+        want = 3 * N_REQUESTS
+        if md["completed"] != want:
+            fail(
+                f"--models smoke run must complete all {want} requests "
+                f"({N_REQUESTS} per deployment), got {md['completed']}"
+            )
+        if set(md["per_model"]) != {"lm", "clf", "ox"}:
+            fail(f"per_model must hold lm/clf/ox, got "
+                 f"{sorted(md['per_model'])}")
+        for name, sub in md["per_model"].items():
+            for key, types in REQUIRED_MULTIMODEL_MODEL_KEYS.items():
+                if key not in sub:
+                    fail(f"per_model.{name}: missing key {key!r}")
+                if not isinstance(sub[key], types):
+                    fail(
+                        f"per_model.{name}: key {key!r} has type "
+                        f"{type(sub[key]).__name__}, expected one of "
+                        f"{[t.__name__ for t in types]}"
+                    )
+        if md["per_model"]["lm"]["kind"] != "lm":
+            fail("per_model.lm must be kind 'lm'")
+        # the LM deployment keeps its compile pins on the shared line
+        if not md["per_model"]["lm"]["decode_compile_count"] >= 1:
+            fail("per_model.lm must report decode_compile_count >= 1")
+        for name in ("clf", "ox"):
+            sub = md["per_model"][name]
+            if sub["kind"] != "batch":
+                fail(f"per_model.{name} must be kind 'batch'")
+            if not (1 <= sub["batch_compile_count"]
+                    <= sub["num_batch_buckets"]):
+                fail(
+                    f"per_model.{name}: batch_compile_count "
+                    f"{sub['batch_compile_count']} outside "
+                    f"[1, num_batch_buckets="
+                    f"{sub['num_batch_buckets']}] — the bucket-ladder "
+                    "compile pin broke"
+                )
+        # the SHARED registry: per-model namespaces, no collisions
+        reg = md["registry"]
+        for name in ("lm", "clf", "ox"):
+            key = f"model{name}.serve.completed"
+            if reg.get(key) != N_REQUESTS:
+                fail(
+                    f"registry key {key!r} must equal {N_REQUESTS}, "
+                    f"got {reg.get(key)!r}"
+                )
+        ppath = os.path.join(tdir, "metrics.prom")
+        if not os.path.exists(ppath):
+            fail("--models --telemetry-dir did not produce metrics.prom")
+        prom = open(ppath, encoding="utf-8").read()
+        for needle in ("modellm_serve_ttft_ms",
+                       "modelclf_serve_ttft_ms",
+                       "modelox_serve_ttft_ms",
+                       "modellm_serve_completed_total",
+                       "modelclf_serve_completed_total",
+                       "modelox_serve_completed_total"):
+            if needle not in prom:
+                fail(f"--models metrics.prom lacks {needle!r}")
+        samples = [
+            ln.split()[0] for ln in prom.splitlines()
+            if ln and not ln.startswith("#")
+        ]
+        if len(samples) != len(set(samples)):
+            dupes = sorted({s for s in samples if samples.count(s) > 1})
+            fail(f"--models metrics.prom has duplicate sample lines "
+                 f"(namespace collision): {dupes[:5]}")
+        mpath = os.path.join(tdir, "metrics.json")
+        if not os.path.exists(mpath):
+            fail("--models --telemetry-dir did not produce metrics.json")
+        persisted = json.load(open(mpath, encoding="utf-8"))
+        missing = set(REQUIRED_MULTIMODEL_KEYS) - set(persisted)
+        if missing:
+            fail(f"--models metrics.json lacks keys {missing}")
+        epath = os.path.join(tdir, "events.jsonl")
+        try:
+            lines = open(epath, encoding="utf-8").read().splitlines()
+        except OSError as e:
+            fail(f"--models events.jsonl unreadable: {e}")
+        names = set()
+        routed_models = set()
+        for line in lines[1:]:
+            try:
+                ev = json.loads(line)
+                names.add(ev["name"])
+                if ev["name"] == "routed":
+                    routed_models.add(ev.get("attrs", {}).get("model"))
+            except (json.JSONDecodeError, KeyError) as e:
+                fail(f"--models events.jsonl malformed line: {e}")
+        for needle in ("deployment_added", "routed", "batch_dispatch"):
+            if needle not in names:
+                fail(
+                    f"--models events.jsonl lacks {needle!r} events "
+                    f"(names seen: {sorted(names)})"
+                )
+        if routed_models != {"lm", "clf", "ox"}:
+            fail(
+                f"routed events must carry every model name, got "
+                f"{sorted(routed_models)}"
+            )
+    print(
+        f"check_metrics_schema: OK — --models line carries "
+        f"{len(REQUIRED_MULTIMODEL_KEYS)} engine keys and "
+        f"{len(REQUIRED_MULTIMODEL_MODEL_KEYS)}+ per-model keys for 3 "
+        f"deployments; {md['completed']} requests completed under one "
+        f"device budget; model{{name}} namespaces collision-free in "
+        f"the exposition"
+    )
+
+
 def check_int8_mode(env: dict, repo: str) -> None:
     """Third smoke pass: the same demo config at ``--kv-dtype bf16``
     and ``--kv-dtype int8`` (+ ``--quantize-weights``). Pins the
@@ -894,6 +1107,10 @@ def main() -> None:
     if "--train" in sys.argv[1:]:
         # the train-resilience gate likewise runs on its own
         check_train_mode(env, repo)
+        return
+    if "--multi-model" in sys.argv[1:]:
+        # the multi-model gate runs the serve --models surface on its own
+        check_multimodel_mode(env, repo)
         return
     with tempfile.TemporaryDirectory() as tdir:
         # --mesh makes the run exercise the SHARDED engine, so the gate

@@ -106,6 +106,10 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   python -m pytest tests/test_serve_fleet.py -q
   python tools/check_metrics_schema.py --disagg
 
+  step "multi-model gate (LM + stateless zoo deployments, one engine)"
+  python -m pytest tests/test_multimodel.py -q
+  python tools/check_metrics_schema.py --multi-model
+
   step "training resilience gate (fault drills / atomic resume / quarantine)"
   python -m pytest tests/test_train_resilience.py -q
   python tools/check_metrics_schema.py --train
