@@ -116,6 +116,7 @@ def cmd_train(args) -> int:
         checkpoint_every=args.checkpoint_every,
         anomaly_limit=args.anomaly_limit,
         max_grad_norm=args.max_grad_norm,
+        audit_every=args.audit_every,
         mesh=args.mesh or None,
         checkpoint_dir=args.checkpoint_dir or None,
         telemetry_dir=args.telemetry_dir or None,
@@ -419,6 +420,13 @@ def main(argv: list[str] | None = None) -> int:
         "--max-grad-norm", type=float, default=0.0, metavar="G",
         help="treat grad_norm > G as an anomaly too (0 = only "
         "non-finite loss/grad count)",
+    )
+    sp.add_argument(
+        "--audit-every", type=int, default=0, metavar="K",
+        help="fold an in-graph params+opt-state checksum into the "
+        "compiled step every K steps and cross-check every replica's "
+        "copy on the host — the silent-data-corruption audit "
+        "(docs/TRAINING.md 'Integrity audits'; 0 = off)",
     )
     sp.add_argument(
         "--mesh", default="", metavar="AXES",

@@ -55,6 +55,15 @@ Fault kinds:
     Raises :class:`EngineKilled` — the simulated process crash for the
     snapshot/restore drill. NOT retried and NOT caught by ``run()``:
     the engine is dead; rebuild it with ``ServeEngine.restore``.
+``corrupt``
+    Silent data corruption: a seeded single-bit flip on a chosen
+    pytree leaf or wire payload (core/integrity.py), decided via
+    :meth:`FaultInjector.corrupt_spec`. Like ``poison`` it is a
+    VALUE kind — never raised; the call site applies the flip and the
+    integrity plane (in-graph audits, payload/snapshot/checkpoint
+    checksums) must detect it. Spelled ``site:corrupt=rate`` in
+    :func:`parse_fault_spec` specs, e.g.
+    ``"seed=7,train.step:corrupt=0.05"``.
 """
 
 from __future__ import annotations
@@ -102,7 +111,10 @@ SITES = (
 )
 #: fault kinds fire() raises/sleeps for, in rate-table draw order
 FIRE_KINDS = ("transient", "oom", "stall", "kill")
-KINDS = FIRE_KINDS + ("poison",)
+#: value kinds — never raised; the call site applies the corruption
+#: (``poison`` via poison_value/poison_block, ``corrupt`` via
+#: corrupt_spec + core/integrity.py's seeded bit-flip helpers)
+KINDS = FIRE_KINDS + ("poison", "corrupt")
 
 #: poison token injected when a Fault does not name its own value —
 #: negative, so it is out-of-range for every vocabulary
@@ -397,6 +409,30 @@ class FaultInjector:
         for slot, value in hit:
             tokens[slot, 0] = value
         return tokens
+
+    def corrupt_spec(self, site: str, *, tick: int,
+                     request: int | None = None,
+                     slot: int | None = None,
+                     replica: int | None = None) -> int | None:
+        """Decide whether this hook firing suffers silent data
+        corruption: returns a deterministic bit-flip seed (for
+        core/integrity.py's ``flip_bit_*`` / ``corrupt_replica``
+        helpers) or None. A scheduled :class:`Fault` whose ``value``
+        is set (non-default) pins the seed exactly — how a drill flips
+        the SAME bit every replay; otherwise the seed derives from the
+        injector's corrupt count, so rate-drawn flips are replayable
+        too. The call site applies the flip; this method only decides
+        and records."""
+        f = self._take(site, ("corrupt",), tick=tick, request=request,
+                       slot=slot, replica=replica)
+        if f is None and self._draw(site, ("corrupt",)) is None:
+            return None
+        ordinal = self.counts.get("corrupt", 0)
+        self._record("corrupt", site)
+        if f is not None and f.value != POISON_TOKEN:
+            return int(f.value)
+        # derived seed: distinct per injection, identical per replay
+        return ordinal * 1_000_003 + 17
 
 
 def parse_fault_spec(spec: str) -> FaultInjector:

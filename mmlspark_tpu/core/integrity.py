@@ -1,0 +1,459 @@
+"""End-to-end integrity plane: checksums, seeded bit-flips, and the
+typed corruption errors every layer state crosses raises.
+
+Loud failures (transients, OOMs, kills, torn writes) are already
+drilled by :mod:`mmlspark_tpu.core.faults`; this module defends against
+*silent* corruption — a flipped bit in a donated train-step carry, a
+corrupted KV hand-off payload, a damaged checkpoint at rest. The
+TensorFlow system paper (arXiv:1605.08695 §4.3) makes checkpointed
+state the backbone of fault tolerance, and cross-replica weight-update
+sharding (PAPERS.md) makes replica-held state the unit of scale — both
+presume that state is *trustworthy*. Four verification surfaces make
+it verifiable (docs/TRAINING.md "Integrity audits", docs/SERVING.md
+"Hand-off checksums"):
+
+- **In-graph pytree fold** (:func:`tree_checksum`): a position-salted
+  wraparound ``uint32`` fold over the bitcast words of every leaf,
+  cheap enough to ride the trainer's donated carry at ``audit_every``
+  cadence. :func:`tree_checksum_host` is the bit-identical numpy twin,
+  so a host audit can compare device-held copies against the compiled
+  step's own fold without re-tracing anything.
+- **Wire payloads** (:func:`payload_checksum` /
+  :func:`verify_payload`): sha256 over a KV hand-off payload's token
+  sequence, geometry, first token, and cache leaves — stamped when the
+  prefill engine produces the payload, verified when a decode engine
+  (or the fleet prefix index) adopts it.
+- **Snapshots** (:func:`json_checksum`): sha256 over the canonical
+  JSON of an engine snapshot; ``ServeEngine.restore`` rejects a
+  corrupted snapshot with :class:`SnapshotCorruption` BEFORE
+  rebuilding.
+- **Checkpoints at rest** (:func:`dir_sha256`): sha256 over a
+  checkpoint payload directory, recorded in the manifest at the commit
+  point and verified on restore (:class:`CheckpointCorruption` names
+  both hashes; the store quarantines the corrupt step so the previous
+  committed checkpoint becomes latest).
+
+The seeded ``flip_bit_*`` / :func:`corrupt_replica` helpers are the
+``corrupt`` fault kind's muscle: deterministic single-bit flips on a
+chosen pytree leaf, wire payload, JSON document, or on-disk payload —
+the same seed flips the same bit, so every corruption drill replays.
+
+Checksum math: each leaf is reinterpreted (bitcast, never value
+conversion) as unsigned words, and the fold is
+``sum(word[i] * (i * MIX + 2*leaf_index + 1)) mod 2**32``. ``MIX`` is
+even, the per-leaf salt odd, so every position multiplier is odd and
+therefore invertible mod 2**32 — any single-word change (in
+particular any single bit-flip) changes the fold, and word/leaf order
+both matter. Not cryptographic; it is an SDC detector, not an
+authenticator (the sha256 surfaces cover at-rest and wire payloads).
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from mmlspark_tpu.core.exceptions import MMLError
+
+#: word-position multiplier stride (even; golden-ratio mix constant)
+_MIX = 0x9E3779B8
+
+#: payload fields folded into :func:`payload_checksum`, in hash order.
+#: ``prompt``/``prefix`` hash as ONE concatenated sequence: a fleet
+#: index entry re-serves the same KV under ``prompt=seq, prefix=[]``,
+#: and the checksum must survive that re-spelling unchanged.
+HANDOFF_CHECKSUM_FIELDS = (
+    "prompt+prefix", "length", "first_token", "kv",
+)
+
+
+class IntegrityError(MMLError):
+    """Base of every checksum-mismatch detection. Deliberately NOT a
+    FriendlyError: corruption is the runtime/storage failing, not the
+    user misusing the API — and broad FriendlyError handlers (missing
+    checkpoint, bad snapshot version) must never swallow it."""
+
+    def __init__(self, message: str, *, expected: str | int,
+                 actual: str | int):
+        self.expected = expected
+        self.actual = actual
+        super().__init__(message)
+
+
+class CheckpointCorruption(IntegrityError):
+    """A checkpoint payload whose bytes no longer hash to the sha256
+    the manifest committed. Carries ``step``, ``expected`` and
+    ``actual``; the store quarantines the corrupt step before raising,
+    so the previous committed checkpoint is already latest."""
+
+    def __init__(self, step: int, *, expected: str, actual: str):
+        self.step = int(step)
+        super().__init__(
+            f"checkpoint step {step} payload is corrupt: manifest "
+            f"committed sha256 {expected} but the payload on disk "
+            f"hashes to {actual}; the corrupt step was quarantined and "
+            "the previous committed checkpoint (if any) is now latest",
+            expected=expected, actual=actual,
+        )
+
+
+class SnapshotCorruption(IntegrityError):
+    """An engine snapshot whose canonical JSON no longer hashes to its
+    stamped checksum — restoring it would resurrect corrupted request
+    state, so ``ServeEngine.restore`` rejects it before rebuilding."""
+
+    def __init__(self, *, expected: str, actual: str):
+        super().__init__(
+            f"serve snapshot is corrupt: stamped checksum {expected} "
+            f"but the snapshot hashes to {actual}; rebuild from an "
+            "intact snapshot or start a fresh engine",
+            expected=expected, actual=actual,
+        )
+
+
+# -- in-graph + host pytree folds ------------------------------------------
+
+
+def _host_words(arr: np.ndarray) -> np.ndarray:
+    """Reinterpret one host leaf as a flat unsigned-word stream (the
+    numpy twin of :func:`_device_words` — same words, same order)."""
+    arr = np.ascontiguousarray(arr).reshape(-1)
+    if arr.dtype == np.bool_:
+        return arr.astype(np.uint32)
+    size = arr.dtype.itemsize
+    if size == 1:
+        return arr.view(np.uint8).astype(np.uint32)
+    if size == 2:
+        return arr.view(np.uint16).astype(np.uint32)
+    # 4-byte words directly; 8-byte leaves split into two words each
+    return arr.view(np.uint32)
+
+
+def tree_checksum_host(tree) -> int:
+    """Host fold over a pytree of (numpy) arrays — bit-identical to
+    :func:`tree_checksum` over the same values, so device and host
+    audits compare directly. Returns the fold as a non-negative
+    Python int in ``[0, 2**32)``."""
+    import jax
+
+    acc = 0
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        w = _host_words(np.asarray(leaf))
+        if not w.size:
+            continue
+        mult = (
+            np.arange(w.size, dtype=np.uint32) * np.uint32(_MIX)
+            + np.uint32(2 * i + 1)
+        )
+        acc = (acc + int(np.sum(w * mult, dtype=np.uint32))) % (1 << 32)
+    return acc
+
+
+def tree_checksum(tree):
+    """In-graph fold over a pytree of device arrays: a traced
+    ``uint32`` scalar, safe inside jit (and under sharding — the sum
+    commutes, so GSPMD's partial-sum + all-reduce lowering produces
+    the same words-times-multipliers total). Leaves are BITCAST to
+    unsigned words, never value-converted, so the fold sees the exact
+    bits the checkpoint/hand-off planes would serialize."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def words(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.dtype == jnp.bool_:
+            return leaf.astype(jnp.uint32).reshape(-1)
+        size = leaf.dtype.itemsize
+        if size == 1:
+            return lax.bitcast_convert_type(
+                leaf, jnp.uint8
+            ).astype(jnp.uint32).reshape(-1)
+        if size == 2:
+            return lax.bitcast_convert_type(
+                leaf, jnp.uint16
+            ).astype(jnp.uint32).reshape(-1)
+        # 4-byte dtypes map 1:1; 8-byte dtypes gain a minor axis of two
+        # uint32 words (little-endian, matching the host twin's view)
+        return lax.bitcast_convert_type(leaf, jnp.uint32).reshape(-1)
+
+    acc = jnp.zeros((), jnp.uint32)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        w = words(leaf)
+        if not w.size:
+            continue
+        mult = (
+            lax.iota(jnp.uint32, w.size) * jnp.uint32(_MIX)
+            + jnp.uint32(2 * i + 1)
+        )
+        acc = acc + jnp.sum(w * mult, dtype=jnp.uint32)
+    return acc
+
+
+def per_device_checksums(tree) -> dict[int, int]:
+    """Host fold of EACH device's addressable copy of a (replicated)
+    pytree: ``{device_id: fold}``. Data-parallel replicas must hold
+    bit-identical state, so any spread across the values is a
+    silent-data-corruption signal — the trainer's cross-replica audit.
+    Non-array leaves hash identically into every device's fold."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    devices: list[int] | None = None
+    for leaf in leaves:
+        if hasattr(leaf, "addressable_shards"):
+            devices = sorted(
+                {s.device.id for s in leaf.addressable_shards}
+            )
+            break
+    if not devices:
+        return {0: tree_checksum_host(leaves)}
+    copies: dict[int, list] = {d: [] for d in devices}
+    for leaf in leaves:
+        if hasattr(leaf, "addressable_shards"):
+            by_dev = {
+                s.device.id: s.data for s in leaf.addressable_shards
+            }
+            for d in devices:
+                copies[d].append(np.asarray(by_dev[d]))
+        else:
+            host = np.asarray(leaf)
+            for d in devices:
+                copies[d].append(host)
+    return {d: tree_checksum_host(copies[d]) for d in devices}
+
+
+def device_copy(tree, device_id: int):
+    """Host pytree pulled from ONE device's shards — how the repair
+    path re-replicates from a majority copy instead of trusting
+    ``device_get`` (which reads whichever shard is first, i.e. the
+    possibly-corrupt one)."""
+    import jax
+
+    def pull(leaf):
+        if hasattr(leaf, "addressable_shards"):
+            for s in leaf.addressable_shards:
+                if s.device.id == device_id:
+                    return np.asarray(s.data)
+        return np.asarray(leaf)
+
+    return jax.tree_util.tree_map(pull, tree)
+
+
+# -- sha256 surfaces (wire payloads, snapshots, checkpoints) ----------------
+
+
+def _hash_array(h, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    h.update(str(arr.dtype).encode())
+    h.update(repr(tuple(arr.shape)).encode())
+    h.update(arr.tobytes())
+
+
+def payload_checksum(payload: dict) -> str:
+    """sha256 over a KV hand-off payload's integrity-bearing fields
+    (:data:`HANDOFF_CHECKSUM_FIELDS`). Fetches the cache leaves to
+    host — call at hand-off boundaries only (production and adoption),
+    never inside a decode block."""
+    import jax
+
+    h = hashlib.sha256()
+    seq = np.concatenate([
+        np.asarray(payload["prompt"], np.int32).reshape(-1),
+        np.asarray(payload["prefix"], np.int32).reshape(-1),
+    ])
+    _hash_array(h, seq)
+    h.update(str(int(payload["length"])).encode())
+    h.update(str(int(payload["first_token"])).encode())
+    for leaf in jax.tree_util.tree_leaves(payload["kv"]):
+        _hash_array(h, np.asarray(leaf))
+    return h.hexdigest()
+
+
+def verify_payload(payload: dict) -> tuple[bool, str | None, str | None]:
+    """``(ok, expected, actual)`` for a hand-off payload. A payload
+    without a stamped ``checksum`` passes unverified (pre-integrity
+    producers); a stamped one is recomputed and compared."""
+    expected = payload.get("checksum")
+    if expected is None:
+        return True, None, None
+    actual = payload_checksum(payload)
+    return actual == expected, expected, actual
+
+
+def json_checksum(obj: dict, *, exclude: tuple = ("checksum",)) -> str:
+    """sha256 over the canonical (sorted-key, separator-normalized)
+    JSON of ``obj`` minus ``exclude`` — the snapshot stamp. Canonical
+    form makes the hash independent of dict insertion order."""
+    doc = {k: v for k, v in obj.items() if k not in exclude}
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def dir_sha256(path: str) -> str:
+    """sha256 over every file under ``path`` (relative name + bytes,
+    walked in sorted order) — the checkpoint payload hash the manifest
+    commits. Deterministic for a given payload regardless of write
+    order or filesystem listing order."""
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            h.update(os.path.relpath(full, path).encode())
+            h.update(b"\0")
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+# -- seeded bit-flips (the ``corrupt`` fault kind's muscle) -----------------
+
+
+def flip_bit_array(arr: np.ndarray, seed: int) -> np.ndarray:
+    """Fresh copy of ``arr`` with ONE seeded bit flipped (byte offset
+    and bit index drawn from ``default_rng(seed)``). The input is
+    untouched."""
+    out = np.array(np.ascontiguousarray(arr), copy=True)
+    flat = out.reshape(-1).view(np.uint8)
+    if not flat.size:
+        return out
+    rng = np.random.default_rng(seed)
+    off = int(rng.integers(flat.size))
+    flat[off] ^= np.uint8(1 << int(rng.integers(8)))
+    return out
+
+
+def flip_bit_in_file(path: str, seed: int) -> None:
+    """Flip one seeded bit of the file at ``path`` in place."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        return
+    rng = np.random.default_rng(seed)
+    off = int(rng.integers(len(data)))
+    data[off] ^= 1 << int(rng.integers(8))
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+
+
+def flip_bit_in_dir(directory: str, seed: int) -> str | None:
+    """Flip one seeded bit in the LARGEST file under ``directory``
+    (the array payload, for an orbax checkpoint — the flip that must
+    stay silent until a hash looks). Returns the corrupted path, or
+    None on an empty tree."""
+    files: list[tuple[int, str, str]] = []
+    for root, dirs, names in os.walk(directory):
+        dirs.sort()
+        for name in sorted(names):
+            full = os.path.join(root, name)
+            size = os.path.getsize(full)
+            if size:
+                files.append(
+                    (-size, os.path.relpath(full, directory), full)
+                )
+    if not files:
+        return None
+    files.sort()
+    target = files[0][2]
+    flip_bit_in_file(target, seed)
+    return target
+
+
+def flip_bit_json(obj: dict, seed: int) -> dict:
+    """Deep copy of a JSON-able dict with one seeded bit flipped in
+    one integer leaf (bools excluded — flipping one is a value change,
+    not a bit-level corruption model). Documents without integer
+    leaves come back unchanged."""
+    doc = copy.deepcopy(obj)
+    leaves: list[tuple] = []
+
+    def walk(node):
+        items = (
+            sorted(node.items(), key=lambda kv: str(kv[0]))
+            if isinstance(node, dict) else enumerate(node)
+        )
+        for key, value in items:
+            if isinstance(value, bool):
+                continue
+            if isinstance(value, int):
+                leaves.append((node, key))
+            elif isinstance(value, (dict, list)):
+                walk(value)
+
+    walk(doc)
+    if not leaves:
+        return doc
+    rng = np.random.default_rng(seed)
+    node, key = leaves[int(rng.integers(len(leaves)))]
+    node[key] = int(node[key]) ^ (1 << int(rng.integers(8)))
+    return doc
+
+
+def corrupt_payload(payload: dict, seed: int) -> dict:
+    """The ``serve.handoff`` corrupt drill: a shallow payload copy
+    whose KV cache has one seeded bit flipped in one leaf — device
+    placement preserved, so the corrupted payload is indistinguishable
+    from a genuine wire flip until a checksum looks."""
+    import jax
+
+    pay = dict(payload)
+    leaves, treedef = jax.tree_util.tree_flatten(pay["kv"])
+    candidates = [
+        i for i, leaf in enumerate(leaves) if getattr(leaf, "size", 0)
+    ]
+    if not candidates:
+        return pay
+    rng = np.random.default_rng(seed)
+    li = candidates[int(rng.integers(len(candidates)))]
+    leaf = leaves[li]
+    host = flip_bit_array(np.asarray(leaf), seed)
+    if isinstance(leaf, jax.Array):
+        leaves[li] = jax.device_put(host, leaf.sharding)
+    else:
+        leaves[li] = host
+    pay["kv"] = jax.tree_util.tree_unflatten(treedef, leaves)
+    return pay
+
+
+def corrupt_replica(tree, seed: int, *, device_id: int | None = None):
+    """The ``train.step`` corrupt drill: flip one seeded bit in ONE
+    device's copy of one leaf of a fully-replicated pytree — the
+    injected stand-in for a radiation/DVFS bit-flip in one replica's
+    HBM. Returns ``(new_tree, device_id)``; the other replicas' copies
+    are byte-identical to before, which is exactly the divergence the
+    cross-replica audit must catch. ``(tree, None)`` when the tree has
+    no shard-addressable leaves to corrupt."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    candidates = [
+        i for i, leaf in enumerate(leaves)
+        if hasattr(leaf, "addressable_shards") and leaf.size
+    ]
+    if not candidates:
+        return tree, None
+    rng = np.random.default_rng(seed)
+    li = candidates[int(rng.integers(len(candidates)))]
+    leaf = leaves[li]
+    shards = sorted(
+        leaf.addressable_shards, key=lambda s: s.device.id
+    )
+    if device_id is None:
+        device_id = shards[int(rng.integers(len(shards)))].device.id
+    buffers = []
+    for shard in shards:
+        host = np.asarray(shard.data)
+        if shard.device.id == device_id:
+            host = flip_bit_array(host, seed)
+        buffers.append(jax.device_put(host, shard.device))
+    leaves[li] = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, buffers
+    )
+    return jax.tree_util.tree_unflatten(treedef, leaves), device_id

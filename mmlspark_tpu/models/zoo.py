@@ -19,6 +19,7 @@ import hashlib
 import json
 import os
 import shutil
+import time
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -111,15 +112,29 @@ class Repository:
 
 class ModelDownloader:
     """Download models into a verified local repo (reference
-    ``ModelDownloader``; local repo plays the HDFSRepo role)."""
+    ``ModelDownloader``; local repo plays the HDFSRepo role).
 
-    def __init__(self, local_repo: str, remote: str | Repository | None = None):
+    ``retry_limit``/``retry_backoff_s`` mirror the serve engine's
+    resilience idiom: a torn read or sha256 mismatch deletes the
+    partial payload and RETRIES the fetch (capped deterministic linear
+    backoff, no jitter) before surfacing the error — a single transient
+    bit-flip on the wire should cost one extra fetch, not a failed
+    job."""
+
+    def __init__(self, local_repo: str, remote: str | Repository | None = None,
+                 *, retry_limit: int = 3, retry_backoff_s: float = 0.0):
         self.local_repo = local_repo
         os.makedirs(local_repo, exist_ok=True)
         self.remote = (
             remote if isinstance(remote, Repository)
             else Repository(remote) if remote else None
         )
+        if retry_limit < 0:
+            raise FriendlyError(
+                f"retry_limit must be >= 0, got {retry_limit}"
+            )
+        self.retry_limit = int(retry_limit)
+        self.retry_backoff_s = float(retry_backoff_s)
 
     # -- local side ---------------------------------------------------------
 
@@ -136,7 +151,10 @@ class ModelDownloader:
 
     def download_by_name(self, name: str) -> ModelSchema:
         """Fetch by name with sha256 verification; cached when already
-        present and intact (ModelDownloader.downloadByName :230-236)."""
+        present and intact (ModelDownloader.downloadByName :230-236).
+        Transient fetch/verification failures are retried up to
+        ``retry_limit`` times with capped deterministic backoff; the
+        LAST failure surfaces unchanged."""
         for schema in self.local_models():
             if schema.name == name and self._verify(schema):
                 return schema
@@ -145,6 +163,23 @@ class ModelDownloader:
                 f"model '{name}' not in local repo and no remote configured"
             )
         schema = self.remote.get_schema(name)
+        attempts = 0
+        while True:
+            try:
+                return self._fetch_verified(schema, name)
+            except (FriendlyError, OSError):
+                attempts += 1
+                if attempts > self.retry_limit:
+                    raise
+                if self.retry_backoff_s > 0:
+                    # deterministic linear backoff, capped at 1s — the
+                    # engine's no-jitter reproducibility contract
+                    time.sleep(min(self.retry_backoff_s * attempts, 1.0))
+
+    def _fetch_verified(self, schema: ModelSchema, name: str) -> ModelSchema:
+        """ONE fetch + sha256 verification attempt; a mismatch deletes
+        the partial payload and raises (the retry loop above decides
+        whether to go again)."""
         src = os.path.join(self.remote.root, schema.uri)
         dst = self.local_path(schema)
         if os.path.isdir(src):

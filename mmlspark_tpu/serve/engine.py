@@ -50,7 +50,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from mmlspark_tpu.core import integrity
 from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.integrity import SnapshotCorruption
 from mmlspark_tpu.core.faults import (
     EngineKilled,
     FaultInjector,
@@ -852,6 +854,37 @@ class ServeEngine:
                 # keeps the resulting stream bit-identical).
                 payload = self._handoffs.pop(req.id, None)
                 adopted = False
+                if payload is not None and self._faults is not None:
+                    # the serve.handoff silent-corruption drill: a
+                    # seeded bit-flip in one KV leaf between production
+                    # and adoption
+                    cseed = self._faults.corrupt_spec(
+                        "serve.handoff", tick=tick, request=req.id,
+                        replica=self._replica,
+                    )
+                    if cseed is not None:
+                        payload = integrity.corrupt_payload(payload,
+                                                            cseed)
+                if payload is not None:
+                    ok, expected, actual = integrity.verify_payload(
+                        payload
+                    )
+                    if not ok:
+                        # checksum mismatch: the payload is untrusted —
+                        # discard it and rebuild the same KV from the
+                        # prompt via the full-prefill path below
+                        # (greedy determinism keeps the stream
+                        # bit-identical)
+                        self.metrics.record_integrity_handoff_failure()
+                        self.recorder.record(
+                            "integrity.handoff_checksum", tick=tick,
+                            id=req.id, expected=expected, actual=actual,
+                        )
+                        self.metrics.record_handoff_fallback()
+                        self.recorder.record(
+                            "handoff_fallback", tick=tick, id=req.id,
+                        )
+                        payload = None
                 if payload is not None:
                     with annotate("serve.handoff"):
                         p = len(seq)
@@ -1098,7 +1131,7 @@ class ServeEngine:
                     # token already FINISHES (budget or EOS) skips the
                     # hand-off and completes here via activate below.
                     self.pool.free(slot)
-                    self._outbox.append({
+                    payload = {
                         "id": req.id,
                         "prompt": np.asarray(req.prompt, np.int32),
                         "prefix": np.asarray(req.prefix, np.int32),
@@ -1107,7 +1140,16 @@ class ServeEngine:
                         "kv": cache,
                         "max_new_tokens": req.max_new_tokens,
                         "eos_id": req.eos_id,
-                    })
+                    }
+                    # stamped at PRODUCTION: the adopting replica
+                    # re-hashes before writing the cache into a slot,
+                    # so wire/at-rest corruption downgrades to the
+                    # full-local-prefill fallback instead of silently
+                    # poisoning a stream (docs/SERVING.md)
+                    payload["checksum"] = integrity.payload_checksum(
+                        payload
+                    )
+                    self._outbox.append(payload)
                     self.recorder.record(
                         "handoff_out", tick=tick, id=req.id, seq_len=p,
                     )
@@ -1676,6 +1718,15 @@ class ServeEngine:
                 "snapshot_failed", tick=self.tick, error=str(e),
             )
             return None
+        if self._faults is not None:
+            # the serve.snapshot silent-corruption drill: the flip
+            # lands AFTER the checksum stamp, so the damage is latent
+            # until a restore re-hashes the snapshot
+            cseed = self._faults.corrupt_spec(
+                "serve.snapshot", tick=self.tick, replica=self._replica
+            )
+            if cseed is not None:
+                snap = integrity.flip_bit_json(snap, cseed)
         self._last_snapshot = snap
         self.metrics.record_snapshot()
         self.recorder.record(
@@ -1733,6 +1784,9 @@ class ServeEngine:
             # mappings from scratch, but the crash dump stays auditable
             # (refcount totals vs mapped pages)
             snap["paging"] = self.pool.snapshot()
+        # canonical-JSON self-checksum: restore() re-hashes and rejects
+        # a snapshot whose bytes changed at rest (SnapshotCorruption)
+        snap["checksum"] = integrity.json_checksum(snap)
         return snap
 
     @classmethod
@@ -1745,7 +1799,19 @@ class ServeEngine:
         prefix, so re-prefilling prompt + prefix continues each stream
         bit-identically (the crash drill in tests/test_serve_faults.py
         is the proof). Deadlines and the tick counter are absolute and
-        survive the rebuild."""
+        survive the rebuild.
+
+        A snapshot that carries a ``checksum`` stamp is re-hashed
+        FIRST: a mismatch raises
+        :class:`~mmlspark_tpu.core.integrity.SnapshotCorruption` naming
+        both hashes before any engine state is rebuilt — the caller
+        (the fleet's failover) falls back to a fresh engine + request
+        re-admission rather than resuming from lying state."""
+        stamp = snapshot.get("checksum")
+        if stamp is not None:
+            actual = integrity.json_checksum(snapshot)
+            if actual != stamp:
+                raise SnapshotCorruption(expected=stamp, actual=actual)
         if snapshot.get("version") != 1:
             raise FriendlyError(
                 f"unknown serve snapshot version "

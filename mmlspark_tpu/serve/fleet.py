@@ -56,10 +56,14 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.faults import EngineKilled, FaultInjector
+from mmlspark_tpu.core.integrity import SnapshotCorruption
+from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.telemetry import FlightRecorder, MetricRegistry
 from mmlspark_tpu.serve.engine import ServeEngine
 from mmlspark_tpu.serve.scheduler import RequestResult
 from mmlspark_tpu.serve.supervisor import _LIVE_RANK
+
+_log = get_logger("serve.fleet")
 
 #: replica roles a fleet partitions engines into (``ServeEngine.role``)
 ROLES = ("prefill", "decode")
@@ -219,6 +223,10 @@ class _IndexEntry:
     #: decode replica idxs that adopted this entry (routing prefers
     #: them — their paged prefix caches already hold the pages)
     home: set = field(default_factory=set)
+    #: the producing engine's payload checksum: rides every
+    #: index-served hand-off so the adopting engine re-verifies the
+    #: KV even when it came out of the fleet index, not the wire
+    checksum: str | None = None
 
 
 class DisaggFleet:
@@ -331,6 +339,9 @@ class DisaggFleet:
         )
         self._m_scale_ups = r.counter("serve.scale_ups")
         self._m_scale_downs = r.counter("serve.scale_downs")
+        self._m_snapshot_checksum_failures = r.counter(
+            "serve.integrity.snapshot_checksum_failures"
+        )
         self._tick = 0
         self._next_gid = 0
         self._next_idx = 0
@@ -506,6 +517,11 @@ class DisaggFleet:
             "kv": entry.kv,
             "max_new_tokens": p.max_new_tokens,
             "eos_id": p.eos_id,
+            # the producer's stamp: payload_checksum hashes the
+            # CONCATENATED prompt+prefix sequence, so the entry's
+            # re-spelling (full seq as prompt, empty prefix) still
+            # verifies on adopt
+            "checksum": entry.checksum,
         }
         target = self._adopt_on_decode(p.gid, payload,
                                        prefer=set(entry.home))
@@ -553,6 +569,7 @@ class DisaggFleet:
                 key=key, prompt=seq, length=int(pay["length"]),
                 kv=pay["kv"], first_token=int(pay["first_token"]),
                 last_used=self._tick,
+                checksum=pay.get("checksum"),
             )
             self._index[key] = entry
         else:
@@ -741,18 +758,38 @@ class DisaggFleet:
             old._park_after_kill()
         snap = old.last_snapshot
         rep.state = "restoring"
+        eng = None
+        snap_ids: set[int] = set()
         if snap is not None:
-            eng = ServeEngine.restore(
-                snap, self._graph, self._variables, replica=rep.idx,
-                role=rep.role, faults=self._faults,
-                snapshot_every_ticks=self._snapshot_every,
-                **self._engine_kwargs,
-            )
-            snap_ids = {
-                int(e["id"])
-                for e in list(snap["active"]) + list(snap["queued"])
-            }
-        else:
+            try:
+                eng = ServeEngine.restore(
+                    snap, self._graph, self._variables, replica=rep.idx,
+                    role=rep.role, faults=self._faults,
+                    snapshot_every_ticks=self._snapshot_every,
+                    **self._engine_kwargs,
+                )
+                snap_ids = {
+                    int(e["id"])
+                    for e in list(snap["active"]) + list(snap["queued"])
+                }
+            except SnapshotCorruption as e:
+                # the snapshot's bytes changed since its checksum stamp:
+                # resuming from it would be resuming from lying state.
+                # Fall through to a fresh engine — every routed request
+                # re-adopts from its prompt below, so the corruption
+                # costs re-prefill work, never a wrong token.
+                self._m_snapshot_checksum_failures.inc()
+                self.recorder.record(
+                    "integrity.snapshot_checksum", tick=self._tick,
+                    replica=rep.idx, expected=e.expected,
+                    actual=e.actual,
+                )
+                _log.warning(
+                    "replica %d snapshot failed checksum verification "
+                    "(%s); rebuilding fresh and re-admitting from "
+                    "prompts", rep.idx, e,
+                )
+        if eng is None:
             eng = self._build_engine(rep.idx, rep.role)
             snap_ids = set()
         new_routed: dict[int, int] = {}
@@ -1184,12 +1221,16 @@ class DisaggFleet:
             for role in ROLES
         }
         handoff_fallbacks = 0
+        integrity_handoff_failures = 0
         wall = 0.0
         for rep in self._reps:
             m = rep.engine.metrics
             d = m.to_dict()
             wall = max(wall, d["wall_s"] or 0.0)
             handoff_fallbacks += d["handoff_fallbacks_total"]
+            integrity_handoff_failures += d[
+                "integrity_handoff_checksum_failures_total"
+            ]
             if rep.state in _LIVE_RANK:
                 agg = per_role[rep.role]
                 agg["replicas"] += 1
@@ -1255,6 +1296,12 @@ class DisaggFleet:
                 self.fleet_prefill_tokens_saved_total
             ),
             "replica_failovers_total": self.replica_failovers_total,
+            "integrity_snapshot_checksum_failures_total": (
+                self._m_snapshot_checksum_failures.value
+            ),
+            "integrity_handoff_checksum_failures_total": (
+                integrity_handoff_failures
+            ),
             "drains_total": self.drains_total,
             "scale_ups_total": self.scale_ups_total,
             "scale_downs_total": self.scale_downs_total,

@@ -105,6 +105,16 @@ class ServeMetrics:
         self._handoffs_out = r.counter(n("serve.handoffs_out"))
         self._handoffs_adopted = r.counter(n("serve.handoffs_adopted"))
         self._handoff_fallbacks = r.counter(n("serve.handoff_fallbacks"))
+        # integrity plane (docs/OBSERVABILITY.md "Integrity"):
+        # checksum verification failures on adopted hand-off payloads
+        # and on engine snapshots at restore — every one means silent
+        # corruption was caught before it reached a stream
+        self._integrity_handoff_failures = r.counter(
+            n("serve.integrity.handoff_checksum_failures")
+        )
+        self._integrity_snapshot_failures = r.counter(
+            n("serve.integrity.snapshot_checksum_failures")
+        )
         #: 1 while the engine runs below its configured decode-block
         #: ladder top or admission cap (memory-pressure degradation),
         #: 0 once the recovery probe has re-escalated to full service
@@ -266,6 +276,21 @@ class ServeMetrics:
         return self._handoff_fallbacks.value
 
     @property
+    def integrity_handoff_checksum_failures_total(self) -> int:
+        return self._integrity_handoff_failures.value
+
+    @property
+    def integrity_snapshot_checksum_failures_total(self) -> int:
+        return self._integrity_snapshot_failures.value
+
+    @property
+    def integrity_checksum_failures_total(self) -> int:
+        """All checksum verifications that failed on this engine, any
+        surface (the headline integrity scalar)."""
+        return (self._integrity_handoff_failures.value
+                + self._integrity_snapshot_failures.value)
+
+    @property
     def tokens_generated(self) -> int:
         return self._tokens_generated.value
 
@@ -394,6 +419,17 @@ class ServeMetrics:
         """One hand-off adoption that failed (fault/retry exhaustion)
         and fell back to a full local prefill."""
         self._handoff_fallbacks.inc()
+
+    def record_integrity_handoff_failure(self) -> None:
+        """One adopted hand-off payload whose checksum did not verify
+        (the adoption fell back to a full local prefill)."""
+        self._integrity_handoff_failures.inc()
+
+    def record_integrity_snapshot_failure(self) -> None:
+        """One snapshot rejected at restore because its stamped
+        checksum did not re-hash (failover fell back to a fresh
+        engine)."""
+        self._integrity_snapshot_failures.inc()
 
     def ttft_p99_ms(self) -> float:
         """The routing signal the supervisor reads per replica (with
@@ -543,6 +579,16 @@ class ServeMetrics:
             "handoffs_out_total": self.handoffs_out_total,
             "handoffs_adopted_total": self.handoffs_adopted_total,
             "handoff_fallbacks_total": self.handoff_fallbacks_total,
+            # integrity plane (docs/OBSERVABILITY.md "Integrity";
+            # schema-gated): checksum failures caught at hand-off
+            # adoption and snapshot restore — zeros on a healthy
+            # engine, so the flat schema stays fixed
+            "integrity_checksum_failures_total":
+                self.integrity_checksum_failures_total,
+            "integrity_handoff_checksum_failures_total":
+                self.integrity_handoff_checksum_failures_total,
+            "integrity_snapshot_checksum_failures_total":
+                self.integrity_snapshot_checksum_failures_total,
             # device-level analytics (docs/OBSERVABILITY.md
             # "Device-level performance analytics"; schema-gated):
             # headline utilization, the device-vs-host time split, the

@@ -56,6 +56,7 @@ import numpy as np
 
 from mmlspark_tpu.core.exceptions import FriendlyError
 from mmlspark_tpu.core.faults import EngineKilled, FaultInjector
+from mmlspark_tpu.core.integrity import SnapshotCorruption
 from mmlspark_tpu.core.telemetry import FlightRecorder, MetricRegistry
 from mmlspark_tpu.serve.engine import ServeEngine
 from mmlspark_tpu.serve.scheduler import RequestResult
@@ -216,6 +217,9 @@ class ReplicaSet:
         self._m_hedges = r.counter("serve.hedges")
         self._m_hedge_waste = r.counter("serve.hedge_wasted_tokens")
         self._m_drains = r.counter("serve.drains")
+        self._m_snapshot_checksum_failures = r.counter(
+            "serve.integrity.snapshot_checksum_failures"
+        )
         self._tick = 0
         self._next_gid = 0
         self._total_failovers = 0
@@ -519,19 +523,33 @@ class ReplicaSet:
             old._park_after_kill()
         snap = old.last_snapshot
         rep.state = "restoring"
+        eng = None
+        snap_ids: set[int] = set()
         if snap is not None:
             graph, variables = self._model_src(rep.idx)
-            eng = ServeEngine.restore(
-                snap, graph, variables, replica=rep.idx,
-                faults=self._faults,
-                snapshot_every_ticks=self._snapshot_every,
-                **self._engine_kwargs,
-            )
-            snap_ids = {
-                int(e["id"])
-                for e in list(snap["active"]) + list(snap["queued"])
-            }
-        else:
+            try:
+                eng = ServeEngine.restore(
+                    snap, graph, variables, replica=rep.idx,
+                    faults=self._faults,
+                    snapshot_every_ticks=self._snapshot_every,
+                    **self._engine_kwargs,
+                )
+                snap_ids = {
+                    int(e["id"])
+                    for e in list(snap["active"]) + list(snap["queued"])
+                }
+            except SnapshotCorruption as e:
+                # a snapshot whose bytes no longer match its stamp is
+                # untrusted: rebuild fresh and re-admit every routed
+                # request from its prompt below (re-prefill cost, never
+                # a wrong token)
+                self._m_snapshot_checksum_failures.inc()
+                self.recorder.record(
+                    "integrity.snapshot_checksum", tick=self._tick,
+                    replica=rep.idx, expected=e.expected,
+                    actual=e.actual,
+                )
+        if eng is None:
             eng = self._build_engine(rep.idx)
             snap_ids = set()
         # reconcile the routing table against what the snapshot
@@ -827,6 +845,9 @@ class ReplicaSet:
             ),
             "wall_s": round(wall, 4),
             "replica_failovers_total": self.replica_failovers_total,
+            "integrity_snapshot_checksum_failures_total": (
+                self._m_snapshot_checksum_failures.value
+            ),
             "hedges_total": self.hedges_total,
             "hedge_wasted_tokens_total": self.hedge_wasted_tokens_total,
             "drains_total": self.drains_total,

@@ -37,6 +37,7 @@ def run_train_demo(*, epochs: int = 2, batch_size: int = 32,
                    seed: int = 0, log_every: int = 1,
                    checkpoint_every: int = 1, max_restarts: int = 5,
                    anomaly_limit: int = 5, max_grad_norm: float = 0.0,
+                   audit_every: int = 0,
                    mesh: str | None = None,
                    checkpoint_dir: str | None = None,
                    telemetry_dir: str | None = None,
@@ -67,7 +68,7 @@ def run_train_demo(*, epochs: int = 2, batch_size: int = 32,
         mesh_axes=parse_mesh_axes(mesh) if mesh else None,
         checkpoint_dir=ckpt_dir, checkpoint_every=checkpoint_every,
         anomaly_limit=anomaly_limit, max_grad_norm=max_grad_norm,
-        retry_backoff_s=0.0,
+        retry_backoff_s=0.0, audit_every=audit_every,
     )
     # ONE registry + recorder + injector across restarts: the resumed
     # trainer keeps appending to the same timeline, and the injector's
@@ -112,6 +113,8 @@ def run_train_demo(*, epochs: int = 2, batch_size: int = 32,
         checkpoint_dir=ckpt_dir,
         model_config={"features": features, "classes": classes,
                       "hidden": list(hidden)},
+        audit_every=audit_every,
+        replay_verdicts=trainer.replay_verdicts,
     )
     if injector is not None:
         out["faults_injected"] = dict(injector.counts)

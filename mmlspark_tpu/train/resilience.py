@@ -38,6 +38,7 @@ import shutil
 from typing import Any, Callable
 
 from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.integrity import CheckpointCorruption, dir_sha256
 from mmlspark_tpu.core.logging_utils import get_logger
 
 _log = get_logger("train.resilience")
@@ -55,13 +56,23 @@ class AtomicCheckpointStore:
     models a mid-write crash: the payload (or its ``.tmp``) is on disk
     but no manifest references it, and the store still reports the
     previous step as latest.
+
+    ``post_hash(step, payload_dir)`` — when given — is called AFTER
+    the payload sha256 is computed but before the commit: the silent-
+    corruption drill window. The trainer wires the ``train.checkpoint``
+    ``corrupt`` fault kind there, so an injected bit-flip lands in a
+    payload whose manifest commits the PRE-flip hash — exactly the
+    at-rest corruption :meth:`restore` must detect
+    (:class:`~mmlspark_tpu.core.integrity.CheckpointCorruption`).
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
-                 pre_commit: Callable[[int], None] | None = None):
+                 pre_commit: Callable[[int], None] | None = None,
+                 post_hash: Callable[[int, str], None] | None = None):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max(int(max_to_keep), 1)
         self.pre_commit = pre_commit
+        self.post_hash = post_hash
         self._ckptr = None  # lazy orbax StandardCheckpointer
         os.makedirs(self.directory, exist_ok=True)
 
@@ -130,6 +141,14 @@ class AtomicCheckpointStore:
         # once that commit lands, and our manifest must never reference
         # a payload orbax is still writing
         ckptr.wait_until_finished()
+        # payload hash taken at PRODUCTION time: anything that changes
+        # the bytes after this line (the post_hash corrupt drill, a
+        # genuine at-rest flip) is detectable on restore
+        payload_sha = dir_sha256(tmp)
+        if self.post_hash is not None:
+            # the silent-corruption drill window: a bit-flip here lands
+            # in a payload whose manifest commits the pre-flip hash
+            self.post_hash(step, tmp)
         if self.pre_commit is not None:
             # the torn-write drill window: a raise here leaves the
             # payload uncommitted and the previous checkpoint intact
@@ -143,6 +162,7 @@ class AtomicCheckpointStore:
             "format": 1,
             "step": step,
             "payload": os.path.basename(final),
+            "payload_sha256": payload_sha,
             "meta": meta or {},
         }
         mtmp = self._manifest_path(step) + ".tmp"
@@ -155,7 +175,16 @@ class AtomicCheckpointStore:
                 step: int | None = None) -> tuple[dict, dict, int]:
         """Restore ``(state, meta, step)`` for ``step`` (default: the
         latest committed checkpoint). ``target`` shapes/dtypes the
-        orbax restore so the state comes back exactly as saved."""
+        orbax restore so the state comes back exactly as saved.
+
+        Verified restore (docs/TRAINING.md "Integrity audits"): when
+        the manifest committed a ``payload_sha256``, the payload bytes
+        are re-hashed BEFORE orbax reads them; a mismatch quarantines
+        the step (manifest renamed to ``.corrupt`` — preserved as
+        evidence, invisible to :meth:`steps`) and raises
+        :class:`~mmlspark_tpu.core.integrity.CheckpointCorruption`
+        naming both hashes, so the caller's retry lands on the
+        previous committed checkpoint."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -170,10 +199,35 @@ class AtomicCheckpointStore:
             )
         with open(self._manifest_path(step), encoding="utf-8") as f:
             manifest = json.load(f)
+        expected = manifest.get("payload_sha256")
+        if expected is not None:
+            actual = dir_sha256(self._payload_path(step))
+            if actual != expected:
+                self._quarantine(int(step))
+                raise CheckpointCorruption(
+                    int(step), expected=expected, actual=actual
+                )
         state = self._checkpointer().restore(
             self._payload_path(step), target
         )
         return state, manifest.get("meta", {}), int(step)
+
+    def _quarantine(self, step: int) -> None:
+        """Demote a corrupt checkpoint: the manifest renames to
+        ``.corrupt`` (kept for post-mortems; ``steps()`` no longer
+        counts the step) so the previous committed checkpoint becomes
+        latest."""
+        path = self._manifest_path(step)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:  # pragma: no cover - quarantine is best-effort
+            _log.warning("could not quarantine corrupt checkpoint %d",
+                         step)
+        _log.warning(
+            "checkpoint step %d failed payload verification and was "
+            "quarantined; latest committed step is now %s",
+            step, self.latest_step(),
+        )
 
     # -- retention -----------------------------------------------------------
 

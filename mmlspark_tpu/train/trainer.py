@@ -50,6 +50,7 @@ from mmlspark_tpu.core.faults import (
     is_resource_exhausted,
     is_transient,
 )
+from mmlspark_tpu.core.integrity import CheckpointCorruption
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.telemetry import FlightRecorder, MetricRegistry
 from mmlspark_tpu.models.graph import NamedGraph
@@ -128,6 +129,15 @@ class TrainConfig:
     # faults, with deterministic linear backoff retry_backoff_s*attempt
     retry_limit: int = 3
     retry_backoff_s: float = 0.0
+    # integrity audit cadence (docs/TRAINING.md "Integrity audits"):
+    # every N steps the compiled step folds a bitcast-uint32 checksum
+    # of params+optimizer state into its donated carry, and the host
+    # cross-checks every data-parallel replica's copy for bit-identity
+    # (silent-data-corruption detection; a mismatch quarantines the
+    # divergent replica and runs the deterministic-replay adjudicator).
+    # 0 disables the audit — the step program is then byte-identical
+    # to an integrity-unaware build, so default runs pay nothing.
+    audit_every: int = 0
 
 
 def _make_optimizer(cfg: TrainConfig, total_steps: int):
@@ -261,11 +271,23 @@ class SPMDTrainer:
                     "fault_injected", tick=self._step, kind=kind, site=site,
                 )
             faults.listener = _on_fault
+        #: deterministic-replay adjudications, newest last: each entry
+        #: names the audit step, the verdict ("transient_sdc" when the
+        #: replay reproduces the majority/device checksum — the flip
+        #: was isolated corruption of a copy at rest — or
+        #: "software_nondeterminism" when the recomputation itself
+        #: disagrees), and the three checksums compared
+        self.replay_verdicts: list[dict] = []
         # pre-created so the exported schema is stable whether or not a
         # fault ever fires (tools/check_metrics_schema.py --train)
         for name in ("train.retries_total", "train.anomalies_skipped",
                      "train.checkpoints", "train.checkpoint_failures",
-                     "train.faults_injected_total"):
+                     "train.faults_injected_total",
+                     "train.integrity.audits",
+                     "train.integrity.checksum_failures",
+                     "train.integrity.sdc_suspected",
+                     "train.integrity.replay_transient_sdc",
+                     "train.integrity.replay_software_nondeterminism"):
             self.telemetry.counter(name)
         self.telemetry.gauge("train.grad_accum").set(
             max(int(config.grad_accum), 1)
@@ -286,9 +308,23 @@ class SPMDTrainer:
             if self._faults is not None:
                 self._faults.fire("train.checkpoint", tick=step)
 
+        def post_hash(step: int, payload_dir: str) -> None:
+            # the silent-corruption drill window: a corrupt fault here
+            # bit-flips the payload AFTER its sha256 was taken, so the
+            # manifest commits a hash the bytes no longer match —
+            # detected only when a verified restore looks
+            if self._faults is None:
+                return
+            seed = self._faults.corrupt_spec("train.checkpoint",
+                                             tick=step)
+            if seed is not None:
+                from mmlspark_tpu.core import integrity
+
+                integrity.flip_bit_in_dir(payload_dir, seed)
+
         return AtomicCheckpointStore(
             cfg.checkpoint_dir, max_to_keep=cfg.max_checkpoints,
-            pre_commit=pre_commit,
+            pre_commit=pre_commit, post_hash=post_hash,
         )
 
     # -- fault hooks --------------------------------------------------------
@@ -371,18 +407,41 @@ class SPMDTrainer:
         seen_anoms = 0  # last total synced into the per-run counter
 
         store = self._ckpt_store()
+        restored = None
+        meta: dict = {}
+        latest: int | None = None
         if store is not None and cfg.resume and store.latest_step() is not None:
             latest = store.latest_step()
-            # train.restore drill site: transient -> retried read,
-            # kill -> the restore itself crashed (escape)
-            self._fire_hook("train.restore", latest)
             target = {
                 "params": jax.device_get(params),
                 "rest": jax.device_get(rest),
                 "opt_state": jax.device_get(opt_state),
                 "anomaly": {"streak": streak0, "total": anoms0},
             }
-            restored, meta, latest = store.restore(target)
+            while latest is not None:
+                # train.restore drill site: transient -> retried read,
+                # kill -> the restore itself crashed (escape)
+                self._fire_hook("train.restore", latest)
+                try:
+                    restored, meta, latest = store.restore(target)
+                    break
+                except CheckpointCorruption as e:
+                    # verified restore (docs/TRAINING.md "Integrity
+                    # audits"): the store already quarantined the
+                    # corrupt step, so the retry lands on the previous
+                    # committed checkpoint — or a cold start when no
+                    # intact checkpoint remains
+                    self.telemetry.counter(
+                        "train.integrity.checksum_failures"
+                    ).inc()
+                    self.recorder.record(
+                        "integrity.checksum_failure", tick=e.step,
+                        surface="checkpoint", expected=e.expected,
+                        actual=e.actual,
+                    )
+                    _log.warning("%s", e)
+                    latest = store.latest_step()
+        if restored is not None:
             params = restored["params"]
             rest = restored["rest"]
             opt_state = restored["opt_state"]
@@ -435,9 +494,21 @@ class SPMDTrainer:
                 f"({accum * n_data})"
             )
 
-        def make_step_fn(accum: int):
+        audit_every = max(int(cfg.audit_every), 0)
+        audit = audit_every > 0
+
+        def make_step_fn(accum: int, audit: bool = False):
             """One optimizer step at the given accumulation rung, with the
-            in-graph anomaly quarantine fused at the end."""
+            in-graph anomaly quarantine fused at the end.
+
+            With ``audit`` the signature grows a donated uint32 checksum
+            carry plus a ``do_audit`` flag: on audit steps a bitcast
+            fold of the post-step params + optimizer state
+            (:func:`~mmlspark_tpu.core.integrity.tree_checksum`)
+            replaces the carry under ``lax.cond`` — non-audit steps
+            skip the fold entirely, and the host only reads the carry
+            at audit cadence, so the audit adds no per-step host
+            sync (docs/TRAINING.md "Integrity audits")."""
 
             def step_fn(params, rest, opt_state, streak, anoms,
                         bx, by, bmask):
@@ -531,7 +602,26 @@ class SPMDTrainer:
                 return (new_params, new_rest, new_opt, streak, anoms,
                         loss, gnorm)
 
-            return step_fn
+            if not audit:
+                return step_fn
+
+            from mmlspark_tpu.core.integrity import tree_checksum
+
+            def step_audit(params, rest, opt_state, streak, anoms, chk,
+                           bx, by, bmask, do_audit):
+                (new_params, new_rest, new_opt, streak, anoms, loss,
+                 gnorm) = step_fn(params, rest, opt_state, streak,
+                                  anoms, bx, by, bmask)
+                chk2 = jax.lax.cond(
+                    do_audit,
+                    lambda p, o: tree_checksum((p, o)),
+                    lambda p, o: chk,
+                    new_params, new_opt,
+                )
+                return (new_params, new_rest, new_opt, streak, anoms,
+                        chk2, loss, gnorm)
+
+            return step_audit
 
         k_steps = max(int(cfg.steps_per_dispatch), 1)
         if cfg.param_rules:
@@ -582,51 +672,262 @@ class SPMDTrainer:
             """Compile the step (and K-step chunk) programs at one
             accumulation rung. Called once up front and once per rung
             the OOM degrade ladder descends to — one compile per rung,
-            the same honesty as serve's decode-block ladder."""
-            step_fn = make_step_fn(accum)
+            the same honesty as serve's decode-block ladder. With
+            audits on, every program carries the extra donated uint32
+            checksum slot; with audits off the signatures are exactly
+            the pre-integrity ones (bit-identical programs)."""
+            step_fn = make_step_fn(accum, audit)
+            n_carry = 6 if audit else 5
+            n_out = 8 if audit else 7
             if cfg.param_rules:
-                jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4))
+                jitted = jax.jit(
+                    step_fn, donate_argnums=tuple(range(n_carry))
+                )
                 return jitted, None
+            in_sh = (rep_sh,) * n_carry + (data_sh,) * 3
+            if audit:
+                in_sh = in_sh + (rep_sh,)
             jitted = jax.jit(
                 step_fn,
-                in_shardings=(
-                    rep_sh, rep_sh, rep_sh, rep_sh, rep_sh,
-                    data_sh, data_sh, data_sh,
-                ),
-                out_shardings=(rep_sh,) * 7,
-                donate_argnums=(0, 1, 2, 3, 4),
+                in_shardings=in_sh,
+                out_shardings=(rep_sh,) * n_out,
+                donate_argnums=tuple(range(n_carry)),
             )
             chunk_jitted = None
             if k_steps > 1:
-                def chunk_fn(params, rest, opt_state, streak, anoms,
-                             bxs, bys, bms):
+                inner = make_step_fn(accum, False)
+
+                def scan_chunk(params, rest, opt_state, streak, anoms,
+                               bxs, bys, bms):
                     def body(carry, xs):
                         p, r, o, s, a = carry
-                        p, r, o, s, a, loss, gnorm = step_fn(
+                        p, r, o, s, a, loss, gnorm = inner(
                             p, r, o, s, a, *xs
                         )
                         return (p, r, o, s, a), (loss, gnorm)
 
-                    (params, rest, opt_state, streak, anoms), \
-                        (losses, gnorms) = jax.lax.scan(
-                            body, (params, rest, opt_state, streak, anoms),
-                            (bxs, bys, bms),
-                        )
-                    return (params, rest, opt_state, streak, anoms,
-                            losses[-1], gnorms[-1])
+                    return jax.lax.scan(
+                        body, (params, rest, opt_state, streak, anoms),
+                        (bxs, bys, bms),
+                    )
 
+                if audit:
+                    from mmlspark_tpu.core.integrity import tree_checksum
+
+                    def chunk_fn(params, rest, opt_state, streak, anoms,
+                                 chk, bxs, bys, bms, do_audit):
+                        (params, rest, opt_state, streak, anoms), \
+                            (losses, gnorms) = scan_chunk(
+                                params, rest, opt_state, streak, anoms,
+                                bxs, bys, bms,
+                            )
+                        # audit cadence coarsens to the dispatch-chunk
+                        # boundary, the same honesty as the log cadence
+                        chk2 = jax.lax.cond(
+                            do_audit,
+                            lambda p, o: tree_checksum((p, o)),
+                            lambda p, o: chk,
+                            params, opt_state,
+                        )
+                        return (params, rest, opt_state, streak, anoms,
+                                chk2, losses[-1], gnorms[-1])
+                else:
+                    def chunk_fn(params, rest, opt_state, streak, anoms,
+                                 bxs, bys, bms):
+                        (params, rest, opt_state, streak, anoms), \
+                            (losses, gnorms) = scan_chunk(
+                                params, rest, opt_state, streak, anoms,
+                                bxs, bys, bms,
+                            )
+                        return (params, rest, opt_state, streak, anoms,
+                                losses[-1], gnorms[-1])
+
+                chunk_in = (rep_sh,) * n_carry + (chunk_sh,) * 3
+                if audit:
+                    chunk_in = chunk_in + (rep_sh,)
                 chunk_jitted = jax.jit(
                     chunk_fn,
-                    in_shardings=(
-                        rep_sh, rep_sh, rep_sh, rep_sh, rep_sh,
-                        chunk_sh, chunk_sh, chunk_sh,
-                    ),
-                    out_shardings=(rep_sh,) * 7,
-                    donate_argnums=(0, 1, 2, 3, 4),
+                    in_shardings=chunk_in,
+                    out_shardings=(rep_sh,) * n_out,
+                    donate_argnums=tuple(range(n_carry)),
                 )
             return jitted, chunk_jitted
 
         jitted, chunk_jitted = build_programs(accum)
+
+        # -- integrity audit state (docs/TRAINING.md "Integrity audits") --
+        # chk_dev is the donated uint32 carry; the flags are device
+        # residents so flipping audit on/off per dispatch never re-lands
+        # a host scalar (which would retrace nothing but still costs a
+        # transfer per step)
+        from mmlspark_tpu.core import integrity as _integrity
+
+        if audit:
+            chk_dev = jax.device_put(jnp.zeros((), jnp.uint32), rep_sh)
+            flag_on = jax.device_put(jnp.asarray(True), rep_sh)
+            flag_off = jax.device_put(jnp.asarray(False), rep_sh)
+        else:
+            chk_dev = flag_on = flag_off = None
+        audit_base: dict | None = None
+        audit_buf: list[tuple] = []
+
+        def refresh_base() -> None:
+            """Host twin of the current state — the deterministic-replay
+            adjudicator's known-good starting point — plus a cleared
+            dispatch buffer. Refreshed after every audit (clean or not)
+            so replay windows never exceed one audit interval."""
+            nonlocal audit_base
+            audit_base = {
+                "params": jax.device_get(params),
+                "rest": jax.device_get(rest),
+                "opt_state": jax.device_get(opt_state),
+                "streak": jax.device_get(streak_dev),
+                "anoms": jax.device_get(anoms_dev),
+            }
+            audit_buf.clear()
+
+        def replay_from_base():
+            """Re-execute every dispatch since the last clean audit from
+            the host-twin base through the SAME compiled programs;
+            returns the replayed carries + a host fold of the replayed
+            params/opt-state, or ``None`` when there is nothing to
+            replay (no base yet, or a TP run where per-replica replay
+            has no meaning)."""
+            if audit_base is None or not audit_buf or cfg.param_rules:
+                return None
+            p = jax.device_put(audit_base["params"], rep_sh)
+            r = jax.device_put(audit_base["rest"], rep_sh)
+            o = jax.device_put(audit_base["opt_state"], rep_sh)
+            s = jax.device_put(jnp.asarray(audit_base["streak"]), rep_sh)
+            a = jax.device_put(jnp.asarray(audit_base["anoms"]), rep_sh)
+            c = jax.device_put(jnp.zeros((), jnp.uint32), rep_sh)
+            for entry in list(audit_buf):
+                if entry[0] == "chunk":
+                    stacks = tuple(
+                        jax.device_put(jnp.asarray(t), chunk_sh)
+                        for t in entry[1]
+                    )
+                    p, r, o, s, a, c, _, _ = chunk_jitted(
+                        p, r, o, s, a, c, *stacks, flag_off
+                    )
+                else:
+                    bx, by, bm = (
+                        jax.device_put(jnp.asarray(t), data_sh)
+                        for t in entry[1:]
+                    )
+                    p, r, o, s, a, c, _, _ = jitted(
+                        p, r, o, s, a, c, bx, by, bm, flag_off
+                    )
+            fold = _integrity.tree_checksum_host(
+                (jax.device_get(p), jax.device_get(o))
+            )
+            return p, r, o, s, a, fold
+
+        def run_audit(at_step: int) -> None:
+            """Cross-replica integrity audit: the compiled step's
+            in-graph fold (``chk_dev``) is compared against a host fold
+            of EVERY device's copy of params + optimizer state.
+            Data-parallel replicas are bit-identical by construction
+            (grads are psum'd identically everywhere), so any
+            disagreement is silent data corruption or software
+            nondeterminism — the replay adjudicator tells them apart by
+            re-running the interval from the last known-good host twin:
+            a reproducible majority means the original flip was a
+            one-off (transient SDC); an unreproducible fold means the
+            step program itself is nondeterministic."""
+            nonlocal params, rest, opt_state, streak_dev, anoms_dev
+            self.telemetry.counter("train.integrity.audits").inc()
+            chk_val = int(chk_dev)
+            if cfg.param_rules:
+                # TP-sharded params: per-device copies are partial
+                # shards with no replica redundancy to vote with; the
+                # only comparable host fold is over the assembled arrays
+                folds = {-1: _integrity.tree_checksum_host(
+                    (jax.device_get(params), jax.device_get(opt_state))
+                )}
+            else:
+                folds = _integrity.per_device_checksums(
+                    (params, opt_state)
+                )
+            from collections import Counter
+
+            counts = Counter(folds.values())
+            top = max(counts.values())
+            majority = min(v for v, n in counts.items() if n == top)
+            divergent = sorted(d for d, v in folds.items()
+                               if v != majority)
+            if not divergent and majority == chk_val:
+                refresh_base()
+                return
+            self.telemetry.counter("train.integrity.sdc_suspected").inc()
+            self.recorder.record(
+                "integrity.sdc_suspected", tick=at_step,
+                device_checksum=chk_val, majority_checksum=majority,
+                divergent_devices=[int(d) for d in divergent],
+            )
+            _log.warning(
+                "step %d: integrity audit mismatch (in-graph fold %d, "
+                "majority host fold %d, divergent device copies %s) — "
+                "silent data corruption suspected",
+                at_step, chk_val, majority, divergent,
+            )
+            if divergent and not cfg.param_rules:
+                # quarantine the divergent replicas: re-replicate every
+                # carry from a majority device — the same
+                # revert-to-known-good move as the anomaly quarantine,
+                # applied across the replica axis
+                src = min(d for d, v in folds.items() if v == majority)
+                p_h, r_h, o_h, s_h, a_h = _integrity.device_copy(
+                    (params, rest, opt_state, streak_dev, anoms_dev),
+                    src,
+                )
+                params = jax.device_put(p_h, rep_sh)
+                rest = jax.device_put(r_h, rep_sh)
+                opt_state = jax.device_put(o_h, rep_sh)
+                streak_dev = jax.device_put(jnp.asarray(s_h), rep_sh)
+                anoms_dev = jax.device_put(jnp.asarray(a_h), rep_sh)
+                self.recorder.record(
+                    "integrity.replica_quarantined", tick=at_step,
+                    devices=[int(d) for d in divergent],
+                    source=int(src),
+                )
+                _log.warning(
+                    "step %d: quarantined divergent replica copies %s; "
+                    "re-replicated from device %d", at_step,
+                    [int(d) for d in divergent], src,
+                )
+            replayed = replay_from_base()
+            if replayed is not None:
+                p, r, o, s, a, fold = replayed
+                verdict = (
+                    "transient_sdc" if fold in (majority, chk_val)
+                    else "software_nondeterminism"
+                )
+                self.telemetry.counter(
+                    "train.integrity.replay_transient_sdc"
+                    if verdict == "transient_sdc" else
+                    "train.integrity.replay_software_nondeterminism"
+                ).inc()
+                entry = {
+                    "step": int(at_step), "verdict": verdict,
+                    "replayed_checksum": int(fold),
+                    "device_checksum": int(chk_val),
+                    "majority_checksum": int(majority),
+                }
+                self.replay_verdicts.append(entry)
+                self.recorder.record(
+                    "integrity.replay", tick=at_step,
+                    **{k: v for k, v in entry.items() if k != "step"},
+                )
+                _log.warning("step %d: replay adjudication -> %s",
+                             at_step, verdict)
+                if verdict == "transient_sdc" and not divergent:
+                    # no majority vote repaired the state (every replica
+                    # copy agreed with the corrupt lineage): adopt the
+                    # verified replayed state as current
+                    params, rest, opt_state = p, r, o
+                    streak_dev, anoms_dev = s, a
+            refresh_base()
 
         def guarded_fire(tick: int) -> None:
             """The ``train.step`` hook + its resilience policy, fired
@@ -721,6 +1022,8 @@ class SPMDTrainer:
         from mmlspark_tpu.data.feed import MASK_COL, batch_iterator
         from mmlspark_tpu.data.dataset import Dataset
 
+        if audit:
+            refresh_base()
         step = step0
         self._step = step
         start_epoch = step0 // steps_per_epoch
@@ -760,6 +1063,7 @@ class SPMDTrainer:
             for group in grouped(it):
                 t_group = time.perf_counter()
                 self._step = step
+                audit_due = False
                 if self._faults is not None:
                     group = [pull_guard(b, step + i)
                              for i, b in enumerate(group)]
@@ -772,11 +1076,35 @@ class SPMDTrainer:
                         )
                         for c in ("x", "y", MASK_COL)
                     )
-                    (params, rest, opt_state, streak_dev, anoms_dev,
-                     loss, gnorm) = chunk_jitted(
-                        params, rest, opt_state, streak_dev, anoms_dev,
-                        *stacks,
-                    )
+                    if audit:
+                        audit_buf.append(("chunk", tuple(
+                            np.stack([np.asarray(b[c]) for b in group])
+                            for c in ("x", "y", MASK_COL)
+                        )))
+                        due = any(
+                            (s + 1) % audit_every == 0
+                            for s in range(step, step + len(group))
+                        )
+                        (params, rest, opt_state, streak_dev, anoms_dev,
+                         chk_dev, loss, gnorm) = chunk_jitted(
+                            params, rest, opt_state, streak_dev,
+                            anoms_dev, chk_dev, *stacks,
+                            flag_on if due else flag_off,
+                        )
+                        audit_due = audit_due or due
+                    else:
+                        (params, rest, opt_state, streak_dev, anoms_dev,
+                         loss, gnorm) = chunk_jitted(
+                            params, rest, opt_state, streak_dev,
+                            anoms_dev, *stacks,
+                        )
+                    if self._faults is not None:
+                        cseed = self._faults.corrupt_spec("train.step",
+                                                          tick=step)
+                        if cseed is not None and not cfg.param_rules:
+                            params, _ = _integrity.corrupt_replica(
+                                params, cseed
+                            )
                     n_done = len(group)
                 else:
                     for i, b in enumerate(group):
@@ -786,11 +1114,39 @@ class SPMDTrainer:
                         bm = jax.device_put(
                             jnp.asarray(b[MASK_COL]), data_sh
                         )
-                        (params, rest, opt_state, streak_dev, anoms_dev,
-                         loss, gnorm) = jitted(
-                            params, rest, opt_state, streak_dev,
-                            anoms_dev, bx, by, bm,
-                        )
+                        if audit:
+                            audit_buf.append((
+                                "single", np.asarray(b["x"]),
+                                np.asarray(b["y"]),
+                                np.asarray(b[MASK_COL]),
+                            ))
+                            due = (step + i + 1) % audit_every == 0
+                            (params, rest, opt_state, streak_dev,
+                             anoms_dev, chk_dev, loss, gnorm) = jitted(
+                                params, rest, opt_state, streak_dev,
+                                anoms_dev, chk_dev, bx, by, bm,
+                                flag_on if due else flag_off,
+                            )
+                            audit_due = audit_due or due
+                        else:
+                            (params, rest, opt_state, streak_dev,
+                             anoms_dev, loss, gnorm) = jitted(
+                                params, rest, opt_state, streak_dev,
+                                anoms_dev, bx, by, bm,
+                            )
+                        if self._faults is not None:
+                            # the train.step silent-corruption drill: a
+                            # seeded bit-flip lands in ONE device's copy
+                            # of one param leaf AFTER the dispatch, so
+                            # the in-graph fold precedes the flip and
+                            # the next audit's host folds see it
+                            cseed = self._faults.corrupt_spec(
+                                "train.step", tick=step + i
+                            )
+                            if cseed is not None and not cfg.param_rules:
+                                params, _ = _integrity.corrupt_replica(
+                                    params, cseed
+                                )
                     n_done = len(group)
                 # log once if any step in [step, step+n) hits the cadence;
                 # the fetched loss is the group's LAST step's, so label it
@@ -841,6 +1197,12 @@ class SPMDTrainer:
                     self._check_anomalies(streak_dev, anoms_dev,
                                           seen_anoms, step - 1)
                     seen_anoms = max(seen_anoms, int(anoms_dev))
+                if audit and audit_due:
+                    # the interval's ONE audit host sync: read the
+                    # in-graph fold and every replica's copy, adjudicate
+                    # (runs BEFORE the checkpoint save so a detected
+                    # corruption never gets committed to disk)
+                    run_audit(step - 1)
                 if (
                     store is not None
                     and cfg.checkpoint_every

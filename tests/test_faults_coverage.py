@@ -1,0 +1,69 @@
+"""Meta-test: every fault hook point is actually drilled somewhere.
+
+The injector's ``SITES`` tuple is the contract between the runtime's
+hook points and the chaos suites — a site that no test ever names is a
+hook nothing would notice breaking (the hook call could be deleted and
+the suite would stay green). This test greps the test tree itself so
+adding a site to ``SITES`` without a drill fails CI immediately, and so
+does deleting the drill that covered an existing site.
+
+Same spirit for ``KINDS``: every kind the injector can draw must appear
+in at least one drill spec, or the kind's raise/corrupt path is dead
+code as far as the suite is concerned.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+from mmlspark_tpu.core.faults import KINDS, SITES
+
+TESTS_DIR = pathlib.Path(__file__).resolve().parent
+SELF = pathlib.Path(__file__).name
+
+
+def _test_sources() -> dict[str, str]:
+    out: dict[str, str] = {}
+    for path in sorted(TESTS_DIR.glob("test_*.py")):
+        if path.name == SELF:
+            continue
+        out[path.name] = path.read_text(encoding="utf-8")
+    return out
+
+
+@pytest.mark.parametrize("site", SITES)
+def test_every_site_is_drilled(site: str) -> None:
+    """Each hook point in SITES is named by at least one other test
+    (a Fault(...) schedule, a parse_fault_spec string, or a hook-call
+    assertion) — deleting a site's only drill breaks this, not just
+    silently shrinking coverage."""
+    hits = [name for name, src in _test_sources().items() if site in src]
+    assert hits, (
+        f"fault site {site!r} is not exercised by any test under "
+        f"tests/ — add a drill before relying on the hook"
+    )
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_every_kind_is_drilled(kind: str) -> None:
+    """Each injectable kind appears in at least one drill spec."""
+    hits = [name for name, src in _test_sources().items() if kind in src]
+    assert hits, (
+        f"fault kind {kind!r} is not exercised by any test under "
+        f"tests/ — add a drill before relying on the kind"
+    )
+
+
+def test_sites_and_kinds_are_stable_contracts() -> None:
+    """The tuples this meta-test iterates must keep the entries the
+    runtime wires (a rename here must be a deliberate, grep-visible
+    change across the chaos suites)."""
+    assert set(SITES) >= {
+        "serve.prefill", "serve.decode", "serve.snapshot",
+        "serve.handoff", "train.step", "train.checkpoint",
+        "train.restore",
+    }
+    assert set(KINDS) >= {"transient", "oom", "stall", "kill",
+                          "poison", "corrupt"}

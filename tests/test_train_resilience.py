@@ -19,6 +19,7 @@ the serving compile pins.
 
 from __future__ import annotations
 
+import json
 import logging
 
 import jax
@@ -33,6 +34,7 @@ from mmlspark_tpu.core.faults import (
     FaultInjector,
     parse_fault_spec,
 )
+from mmlspark_tpu.core.integrity import CheckpointCorruption, flip_bit_in_dir
 from mmlspark_tpu.models import build_model, generate
 from mmlspark_tpu.train.resilience import (
     AtomicCheckpointStore,
@@ -180,6 +182,86 @@ def test_torn_checkpoint_keeps_previous_restorable(tmp_path):
     resumed = SPMDTrainer(g, _cfg(**ck))
     v_res = resumed.train(x, y)
     assert resumed.history[0]["step"] == 2  # replays exactly one step
+    _assert_trees_equal(v_full, v_res)
+
+
+def _crash_with_checkpoints(tmp_path, g, x, y):
+    """Crash at step 3 with checkpoint_every=1: steps 0..2 committed."""
+    ck = dict(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
+    crashed = SPMDTrainer(
+        g, _cfg(**ck),
+        faults=FaultInjector([Fault("train.step", "kill", tick=3)]),
+    )
+    with pytest.raises(EngineKilled):
+        crashed.train(x, y)
+    return ck
+
+
+def test_bit_flipped_checkpoint_raises_typed_error_and_quarantines(tmp_path):
+    """Silent-corruption drill (ISSUE 18 satellite): one flipped bit
+    in the latest payload makes ``restore()`` raise the typed error
+    naming BOTH hashes before orbax reads anything; the manifest is
+    quarantined (renamed ``.corrupt``) so the previous checkpoint
+    becomes latest."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    ck = _crash_with_checkpoints(tmp_path, g, x, y)
+    ckdir = tmp_path / "ck"
+    store = AtomicCheckpointStore(str(ckdir))
+    assert store.latest_step() == 2
+    manifest = json.loads((ckdir / "step-2.json").read_text())
+    flip_bit_in_dir(str(ckdir / "payload-2"), 5)
+
+    cfg = _cfg(**ck)
+    p0, r0 = _split_variables(
+        jax.device_get(g.init(jax.random.PRNGKey(cfg.seed),
+                              jnp.asarray(x[:1]))))
+    tx = _make_optimizer(cfg, 6)
+    target = {
+        "params": p0, "rest": r0,
+        "opt_state": jax.device_get(tx.init(p0)),
+        "anomaly": {"streak": np.zeros((), np.int32),
+                    "total": np.zeros((), np.int32)},
+    }
+    with pytest.raises(CheckpointCorruption) as exc:
+        store.restore(target)
+    assert exc.value.step == 2
+    assert exc.value.expected == manifest["payload_sha256"]
+    assert exc.value.actual != exc.value.expected
+    assert exc.value.expected in str(exc.value)
+    assert exc.value.actual in str(exc.value)
+    # the corrupt step is quarantined, not deleted: the manifest moves
+    # aside for the post-mortem and the store's view drops to step 1
+    assert (ckdir / "step-2.json.corrupt").exists()
+    assert not (ckdir / "step-2.json").exists()
+    assert AtomicCheckpointStore(str(ckdir)).latest_step() == 1
+
+
+def test_bit_flipped_checkpoint_resume_falls_back_bit_exact(tmp_path):
+    """The trainer-level recovery: a resume that hits the corrupted
+    checkpoint counts the failure, records the event with both hashes,
+    retries onto the PREVIOUS committed checkpoint, and finishes
+    bit-identical to a run that never crashed."""
+    x, y = _two_blob_data()
+    g = build_model("mlp", num_outputs=2, hidden=(8,))
+    v_full = SPMDTrainer(g, _cfg()).train(x, y)
+    ck = _crash_with_checkpoints(tmp_path, g, x, y)
+    ckdir = tmp_path / "ck"
+    manifest = json.loads((ckdir / "step-2.json").read_text())
+    flip_bit_in_dir(str(ckdir / "payload-2"), 9)
+
+    resumed = SPMDTrainer(g, _cfg(**ck))
+    v_res = resumed.train(x, y)
+    fails = resumed.telemetry.counter("train.integrity.checksum_failures")
+    assert fails.value == 1
+    ev = [e for e in resumed.recorder.events()
+          if e["name"] == "integrity.checksum_failure"]
+    assert len(ev) == 1
+    assert ev[0]["attrs"]["expected"] == manifest["payload_sha256"]
+    assert ev[0]["attrs"]["actual"] != ev[0]["attrs"]["expected"]
+    # fell back to step 1 and replayed 2..5 — bit-identical finish
+    assert [h["step"] for h in resumed.restored_history] == [0, 1]
+    assert resumed.history[0]["step"] == 2
     _assert_trees_equal(v_full, v_res)
 
 

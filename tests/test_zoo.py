@@ -128,6 +128,80 @@ def test_torn_download_raises_both_hashes_and_deletes_partial(tmp_path):
         assert json.load(f)["hash"] == fixed.hash
 
 
+class _FlakyRepository(Repository):
+    """Remote whose payload reads fail N times before succeeding —
+    the injected stand-in for a transient network/storage blip."""
+
+    def __init__(self, root, fail_times):
+        super().__init__(root)
+        self.fails_left = fail_times
+        self.payload_reads = 0
+
+    def _read(self, rel):
+        if rel.endswith(".bin"):  # payload reads only, not MANIFEST
+            self.payload_reads += 1
+            if self.fails_left > 0:
+                self.fails_left -= 1
+                raise OSError("injected transient read failure")
+        return super()._read(rel)
+
+
+def _publish_file_payload(tmp_path, name="Retry"):
+    remote = str(tmp_path / "remote")
+    payload = tmp_path / "weights.bin"
+    payload.write_bytes(b"trained weights v1")
+    return remote, publish_model(remote, name, str(payload))
+
+
+def test_transient_download_failure_is_retried(tmp_path):
+    """ISSUE 18 satellite: a transient fetch failure costs one extra
+    fetch, not a failed job — the capped deterministic retry loop
+    absorbs it and the verified payload lands."""
+    remote, schema = _publish_file_payload(tmp_path)
+    repo = _FlakyRepository(remote, fail_times=2)
+    dl = ModelDownloader(str(tmp_path / "local"), remote=repo,
+                         retry_backoff_s=0.0)
+    got = dl.download_by_name("Retry")
+    assert got.hash == schema.hash and dl._verify(got)
+    assert repo.payload_reads == 3  # 2 failures + the success
+
+
+def test_transient_verification_failure_is_retried(tmp_path):
+    """One corrupted transfer (sha256 mismatch) deletes the partial
+    and re-fetches; the second, clean transfer verifies."""
+
+    class _CorruptOnce(Repository):
+        def _read(self, rel):
+            data = super()._read(rel)
+            if rel.endswith(".bin") and not getattr(
+                    self, "_flipped", False):
+                self._flipped = True
+                return data + b"\x00"
+            return data
+
+    remote, schema = _publish_file_payload(tmp_path)
+    dl = ModelDownloader(str(tmp_path / "local"),
+                         remote=_CorruptOnce(remote),
+                         retry_backoff_s=0.0)
+    got = dl.download_by_name("Retry")
+    assert got.hash == schema.hash and dl._verify(got)
+
+
+def test_retry_limit_exhaustion_surfaces_last_error(tmp_path):
+    """Past ``retry_limit`` the LAST failure surfaces unchanged — the
+    loop must not swallow the typed error or spin forever."""
+    remote, _schema = _publish_file_payload(tmp_path)
+    repo = _FlakyRepository(remote, fail_times=100)
+    dl = ModelDownloader(str(tmp_path / "local"), remote=repo,
+                         retry_limit=2, retry_backoff_s=0.0)
+    with pytest.raises(OSError, match="injected transient"):
+        dl.download_by_name("Retry")
+    assert repo.payload_reads == 3  # 1 initial + 2 retries
+
+    with pytest.raises(FriendlyError, match="retry_limit"):
+        ModelDownloader(str(tmp_path / "local2"), retry_limit=-1)
+
+
 def test_schema_json_round_trip():
     s = ModelSchema(name="m", uri="m.bin", hash="ab", size=3,
                     layer_names=("a", "z"), input_node="input")

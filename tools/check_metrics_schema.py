@@ -126,6 +126,13 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "snapshots_total": (int,),
     "snapshot_failures_total": (int,),
     "cancelled_total": (int,),
+    # integrity plane (docs/OBSERVABILITY.md "Integrity"): checksum
+    # verification failures on hand-off adopt / snapshot restore —
+    # always present (0 on a clean run) so SDC dashboards can alert
+    # without existence checks
+    "integrity_handoff_checksum_failures_total": (int,),
+    "integrity_snapshot_checksum_failures_total": (int,),
+    "integrity_checksum_failures_total": (int,),
     # device-level performance analytics (docs/OBSERVABILITY.md
     # "Device-level performance analytics"): the demo run's backend has
     # a working XLA cost model, so the utilization figures must be real
@@ -181,6 +188,7 @@ REQUIRED_REPLICA_KEYS: dict[str, tuple] = {
     "hedges_total": (int,),
     "hedge_wasted_tokens_total": (int,),
     "drains_total": (int,),
+    "integrity_snapshot_checksum_failures_total": (int,),
     "per_replica": (dict,),
 }
 
@@ -228,6 +236,8 @@ REQUIRED_FLEET_KEYS: dict[str, tuple] = {
     "fleet_prefill_tokens_saved_total": (int,),
     "replica_failovers_total": (int,),
     "drains_total": (int,),
+    "integrity_snapshot_checksum_failures_total": (int,),
+    "integrity_handoff_checksum_failures_total": (int,),
     "scale_ups_total": (int,),
     "scale_downs_total": (int,),
     "parked_prefill": (int,),
@@ -273,6 +283,14 @@ REQUIRED_TRAIN_KEYS: dict[str, tuple] = {
     "train.checkpoints": (int,),
     "train.checkpoint_failures": (int,),
     "train.faults_injected_total": (int,),
+    # integrity plane (docs/TRAINING.md "Integrity audits"): audit /
+    # SDC-detection counters — always present (0 with audits off) so
+    # corruption dashboards need no existence checks
+    "train.integrity.audits": (int,),
+    "train.integrity.checksum_failures": (int,),
+    "train.integrity.sdc_suspected": (int,),
+    "train.integrity.replay_transient_sdc": (int,),
+    "train.integrity.replay_software_nondeterminism": (int,),
     # the degrade ladder's current rung
     "train.grad_accum": NUM,
     # step-time / throughput / loss / grad-norm histograms
@@ -315,6 +333,12 @@ REQUIRED_TRAIN_DRILL_EVENTS = {
     "step", "checkpoint", "anomaly", "retry", "fault_injected",
 }
 REQUIRED_TRAIN_KILL_EVENTS = {"step", "checkpoint", "restore", "restart"}
+# the corrupt drill must light up the full SDC pipeline: suspicion,
+# quarantine, and the deterministic-replay adjudication
+REQUIRED_TRAIN_INTEGRITY_EVENTS = {
+    "integrity.sdc_suspected", "integrity.replica_quarantined",
+    "integrity.replay",
+}
 
 
 def fail(msg: str) -> "None":
@@ -932,7 +956,7 @@ def check_int8_mode(env: dict, repo: str) -> None:
 
 
 def _run_train_demo(env: dict, repo: str, tdir: str, faults: str,
-                    label: str) -> tuple[dict, set]:
+                    label: str, extra: tuple = ()) -> tuple[dict, set]:
     """One ``train`` CLI run at smoke scale with an injected-fault
     spec; returns (metrics dict, event names seen). The injector's
     stream is seeded, so the same spec fires the same faults every
@@ -944,6 +968,7 @@ def _run_train_demo(env: dict, repo: str, tdir: str, faults: str,
         "--anomaly-limit", "8", "--faults", faults,
         "--telemetry-dir", tdir,
         "--checkpoint-dir", os.path.join(tdir, "ck"),
+        *extra,
     ]
     res = subprocess.run(
         cmd, capture_output=True, text=True, timeout=300,
@@ -1084,13 +1109,47 @@ def check_train_mode(env: dict, repo: str) -> None:
         if md2["restarts"] < 1:
             fail("train (kill): the kill spec must crash the trainer "
                  "at least once")
+    with tempfile.TemporaryDirectory() as tdir:
+        # integrity drill (docs/TRAINING.md "Integrity audits"): a
+        # seeded train.step bit-flip must be caught by the in-graph
+        # checksum audit, the divergent replica quarantined, and the
+        # deterministic replay adjudicated — with no checkpoint
+        # checksum failures on this surface
+        md3, names3 = _run_train_demo(
+            env, repo, tdir, "seed=3,train.step:corrupt=0.2",
+            "corrupt", extra=("--audit-every", "2"),
+        )
+        missing = REQUIRED_TRAIN_INTEGRITY_EVENTS - names3
+        if missing:
+            fail(f"train (corrupt) events.jsonl lacks {missing} "
+                 f"(names seen: {sorted(names3)})")
+        if md3["train.integrity.audits"] < 1:
+            fail("train (corrupt): --audit-every 2 must run at least "
+                 "one integrity audit")
+        if md3["train.integrity.sdc_suspected"] < 1:
+            fail("train (corrupt): the seeded bit-flip spec must "
+                 "trip at least one cross-replica divergence audit")
+        adjudicated = (md3["train.integrity.replay_transient_sdc"]
+                       + md3["train.integrity.replay_software_nondeterminism"])
+        if adjudicated != md3["train.integrity.sdc_suspected"]:
+            fail(
+                "train (corrupt): every suspected SDC must get a "
+                f"replay verdict — {md3['train.integrity.sdc_suspected']}"
+                f" suspected vs {adjudicated} adjudicated"
+            )
+        if md3["train.integrity.checksum_failures"] != 0:
+            fail("train (corrupt): a step-level drill must not report "
+                 "checkpoint checksum failures")
     print(
         f"check_metrics_schema: OK — --train line carries "
         f"{len(REQUIRED_TRAIN_KEYS)} keys on both surfaces; drill run "
         f"quarantined {md['train.anomalies_skipped']} step(s) and "
         f"retried {md['train.retries_total']} transient(s); kill run "
         f"survived {md2['restarts']} crash(es) with all 6 steps "
-        f"accounted for; train_* counters present in the exposition"
+        f"accounted for; corrupt run caught "
+        f"{md3['train.integrity.sdc_suspected']} bit-flip(s) across "
+        f"{md3['train.integrity.audits']} audit(s); train_* counters "
+        f"present in the exposition"
     )
 
 

@@ -114,6 +114,30 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   python -m pytest tests/test_train_resilience.py -q
   python tools/check_metrics_schema.py --train
 
+  step "integrity gate (SDC detection / checksummed hand-offs / verified restore)"
+  python -m pytest tests/test_integrity.py tests/test_faults_coverage.py -q
+  # corrupt drill through the real CLI: a seeded train.step bit-flip
+  # must be caught, quarantined, and replay-adjudicated (the --train
+  # schema gate above pins the full metric contract; this run pins the
+  # plane end-to-end at a different audit cadence)
+  integrity_tmp=$(mktemp -d)
+  JAX_PLATFORMS=cpu python -m mmlspark_tpu --cpu-mesh 4 train \
+    --epochs 2 --samples 96 --batch-size 32 --seed 0 \
+    --checkpoint-every 2 --audit-every 3 \
+    --faults 'seed=3,train.step:corrupt=0.2' \
+    --telemetry-dir "$integrity_tmp" \
+    --checkpoint-dir "$integrity_tmp/ck" \
+    | python -c '
+import json, sys
+md = json.load(sys.stdin)
+assert md["train.integrity.audits"] >= 1, md
+assert md["train.integrity.sdc_suspected"] >= 1, md
+print("integrity drill: OK —",
+      md["train.integrity.sdc_suspected"], "bit-flip(s) caught across",
+      md["train.integrity.audits"], "audit(s)")
+'
+  rm -rf "$integrity_tmp"
+
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
