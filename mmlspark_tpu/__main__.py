@@ -96,6 +96,7 @@ def cmd_serve(args) -> int:
         autoscale=args.autoscale or None,
         models=args.models or None,
         device_budget=args.device_budget,
+        metrics_port=args.metrics_port,
     )
     print(json.dumps(metrics, default=str))
     return 0
@@ -258,13 +259,23 @@ def main(argv: list[str] | None = None) -> int:
         help="write events.jsonl (per-request trace spans), "
         "metrics.json (latency percentiles), trace.json (Perfetto-"
         "loadable Chrome trace), and metrics.prom (Prometheus text "
-        "exposition) under DIR (docs/OBSERVABILITY.md)",
+        "exposition) under DIR; --replicas/--disagg/--models runs "
+        "write the MERGED TelemetryHub bundle — every replica's "
+        "telemetry stitched by trace id (docs/OBSERVABILITY.md "
+        "'Distributed tracing')",
     )
     sp.add_argument(
         "--trace-out", default="", metavar="PATH",
         help="write the run's Chrome trace-event JSON to PATH — open "
         "it at ui.perfetto.dev: one track per request, tick + program-"
         "dispatch tracks (docs/OBSERVABILITY.md 'Trace export')",
+    )
+    sp.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve live telemetry on 127.0.0.1:PORT while the demo "
+        "runs: /metrics (merged Prometheus exposition), /traces "
+        "(merged Perfetto trace), /healthz. 0 picks an ephemeral "
+        "port (docs/OBSERVABILITY.md 'Distributed tracing')",
     )
     sp.add_argument(
         "--slo", default="", metavar="SPEC",

@@ -814,9 +814,11 @@ def export_chrome_trace(recorder, *, path: str | None = None,
         },
     }
     if path is not None:
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(doc, f, sort_keys=True, separators=(",", ":"),
-                      default=str)
+        from mmlspark_tpu.core.telemetry import atomic_write_text
+
+        atomic_write_text(path, json.dumps(
+            doc, sort_keys=True, separators=(",", ":"), default=str,
+        ))
         _log.info("chrome trace: %d events -> %s",
                   len(doc["traceEvents"]), path)
     return doc

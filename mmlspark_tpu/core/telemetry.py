@@ -42,6 +42,8 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import os
+import tempfile
 import threading
 import time
 from collections import deque
@@ -52,6 +54,35 @@ from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.metrics_contracts import MetricData
 
 _log = get_logger("telemetry")
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Torn-write-safe text dump: write to a tmp file in the target
+    directory, fsync, then ``os.replace`` onto the final name — the
+    same commit-point idiom as ``AtomicCheckpointStore``
+    (train/resilience.py), so a kill mid-dump leaves either the
+    previous file or the complete new one, never a half-written
+    telemetry bundle."""
+    path = os.path.abspath(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path),
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # the commit point
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write_json(path: str, doc, **dump_kwargs) -> None:
+    """:func:`atomic_write_text` for a JSON document."""
+    atomic_write_text(path, json.dumps(doc, **dump_kwargs))
 
 
 # --------------------------------------------------------------------------
@@ -239,7 +270,10 @@ class MetricRegistry:
         return self._metrics.get(name)
 
     def names(self) -> list[str]:
-        return sorted(self._metrics)
+        # locked: a MetricsServer scrape thread iterates while the
+        # serving loop may be registering a new metric
+        with self._lock:
+            return sorted(self._metrics)
 
     def to_dict(self) -> dict:
         """Flat JSON-able view: counters/gauges as scalars, histograms
@@ -255,35 +289,34 @@ class MetricRegistry:
                 out[name] = m.value
         return out
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition (format 0.0.4) for live scraping.
-
-        Dotted metric names become underscore-separated
-        (``serve.ttft_ms`` -> ``serve_ttft_ms``); counters get the
-        conventional ``_total`` suffix; histograms emit CUMULATIVE
-        ``_bucket{le="..."}`` series (one per occupied log-bucket edge
-        plus ``+Inf``) with ``_sum`` and ``_count`` — real
-        distributions, not three precomputed quantiles
-        (docs/OBSERVABILITY.md "Prometheus scraping")."""
-        out: list[str] = []
+    def prom_series(
+        self, labels: dict | None = None,
+    ) -> Iterator[tuple[str, str, list[str]]]:
+        """Per-metric exposition pieces: ``(prom_name, type, sample
+        lines)``, with ``labels`` rendered (escaped) on EVERY sample
+        line. The building block both :meth:`to_prometheus` and the
+        hub's merged label-based exposition
+        (:class:`mmlspark_tpu.core.tracehub.TelemetryHub`) assemble
+        from — the hub groups series from N registries by name, emits
+        one ``# TYPE`` header per name, and distinguishes sources by
+        ``{replica="0"}``-style labels instead of name prefixes."""
         for name in self.names():
             m = self._metrics[name]
             pname = _prom_name(name)
+            lbl = _prom_labels(labels)
             if isinstance(m, Counter):
                 # counters whose dotted name already carries the
                 # conventional suffix (train.retries_total) must not
                 # come out double-suffixed
                 if not pname.endswith("_total"):
                     pname += "_total"
-                out.append(f"# TYPE {pname} counter")
-                out.append(f"{pname} {m.value}")
+                yield pname, "counter", [f"{pname}{lbl} {m.value}"]
             elif isinstance(m, Gauge):
                 if m.value is None:
                     continue
-                out.append(f"# TYPE {pname} gauge")
-                out.append(f"{pname} {_prom_num(m.value)}")
+                yield pname, "gauge", [f"{pname}{lbl} {_prom_num(m.value)}"]
             elif isinstance(m, Histogram):
-                out.append(f"# TYPE {pname} histogram")
+                lines: list[str] = []
                 cum = 0
                 bounds = m.bucket_bounds()
                 for edge, c in zip(bounds, m.bucket_counts()):
@@ -291,11 +324,31 @@ class MetricRegistry:
                     if c == 0 and edge != "+Inf":
                         continue  # occupied edges + +Inf keep it short
                     le = edge if isinstance(edge, str) else _prom_num(edge)
-                    out.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+                    blbl = _prom_labels(labels, {"le": le})
+                    lines.append(f"{pname}_bucket{blbl} {cum}")
                 if bounds[-1] != "+Inf" or not m.bucket_counts():
-                    out.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
-                out.append(f"{pname}_sum {_prom_num(m.sum)}")
-                out.append(f"{pname}_count {m.count}")
+                    blbl = _prom_labels(labels, {"le": "+Inf"})
+                    lines.append(f"{pname}_bucket{blbl} {m.count}")
+                lines.append(f"{pname}_sum{lbl} {_prom_num(m.sum)}")
+                lines.append(f"{pname}_count{lbl} {m.count}")
+                yield pname, "histogram", lines
+
+    def to_prometheus(self, labels: dict | None = None) -> str:
+        """Prometheus text exposition (format 0.0.4) for live scraping.
+
+        Dotted metric names become underscore-separated
+        (``serve.ttft_ms`` -> ``serve_ttft_ms``); counters get the
+        conventional ``_total`` suffix; histograms emit CUMULATIVE
+        ``_bucket{le="..."}`` series (one per occupied log-bucket edge
+        plus ``+Inf``) with ``_sum`` and ``_count`` — real
+        distributions, not three precomputed quantiles. ``labels``
+        stamps every sample line (values escaped per the exposition
+        format) — the hub's per-source dimension
+        (docs/OBSERVABILITY.md "Prometheus scraping")."""
+        out: list[str] = []
+        for pname, mtype, lines in self.prom_series(labels):
+            out.append(f"# TYPE {pname} {mtype}")
+            out.extend(lines)
         return "\n".join(out) + ("\n" if out else "")
 
     def snapshot(self, model: str | None = None,
@@ -329,6 +382,32 @@ def _prom_num(value: float) -> str:
     ``.0``, floats via repr (round-trippable)."""
     f = float(value)
     return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _prom_escape_label_value(value) -> str:
+    """Label-VALUE escaping per the text exposition format 0.0.4:
+    backslash, double-quote and newline must be escaped inside the
+    quoted value (``model="a\\"b"`` would otherwise tear the line).
+    Everything else passes through verbatim."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _prom_labels(labels: dict | None, extra: dict | None = None) -> str:
+    """``{replica="0",le="+Inf"}``-style rendering (escaped, insertion
+    order preserved); empty string when there are no labels."""
+    items = {**(labels or {}), **(extra or {})}
+    if not items:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(str(k))}="{_prom_escape_label_value(v)}"'
+        for k, v in items.items()
+    )
+    return "{" + inner + "}"
 
 
 class NamespacedRegistry:
@@ -372,8 +451,11 @@ class NamespacedRegistry:
     def to_dict(self) -> dict:
         return self._inner.to_dict()
 
-    def to_prometheus(self) -> str:
-        return self._inner.to_prometheus()
+    def to_prometheus(self, labels: dict | None = None) -> str:
+        return self._inner.to_prometheus(labels)
+
+    def prom_series(self, labels: dict | None = None):
+        return self._inner.prom_series(labels)
 
     def snapshot(self, model: str | None = None,
                  group: str | None = None):
@@ -459,8 +541,7 @@ class FlightRecorder:
             [header] + [json.dumps(ev, default=str) for ev in events]
         ) + "\n"
         if path is not None:
-            with open(path, "w", encoding="utf-8") as f:
-                f.write(lines)
+            atomic_write_text(path, lines)
             _log.info("flight recorder: %d events -> %s",
                       len(self._events), path)
         return lines

@@ -14,11 +14,19 @@ in ``trace.json``, and the Prometheus text exposition in
 ``tools/check_metrics_schema.py`` gates (docs/OBSERVABILITY.md).
 ``trace_out`` (the CLI's ``--trace-out``) writes just the trace to an
 explicit path.
+
+Multi-engine runs (``--replicas`` / ``--disagg`` / ``--models``) write
+the MERGED :class:`~mmlspark_tpu.core.tracehub.TelemetryHub` bundle
+instead: one wall-clock-ordered ``events.jsonl`` across every
+replica's recorder, one flow-arrow-stitched ``trace.json``, one
+labeled exposition — plus ``supervisor.events.jsonl``, the
+control-plane-only timeline in the old format. ``metrics_port`` (the
+CLI's ``--metrics-port``) serves the same hub live on 127.0.0.1 while
+the demo runs (docs/OBSERVABILITY.md "Distributed tracing").
 """
 
 from __future__ import annotations
 
-import json
 import os
 
 import numpy as np
@@ -47,7 +55,8 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              decode_replicas: int = 1,
              autoscale: str | None = None,
              models: str | None = None,
-             device_budget: int | None = None) -> dict:
+             device_budget: int | None = None,
+             metrics_port: int | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line. With ``replicas > 1`` the loop drives
     a :class:`~mmlspark_tpu.serve.supervisor.ReplicaSet` instead of a
@@ -76,6 +85,7 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
             device_budget=device_budget,
             injector=parse_fault_spec(faults) if faults else None,
             telemetry_dir=telemetry_dir, trace_out=trace_out,
+            metrics_port=metrics_port,
         )
 
     graph = build_model(
@@ -128,23 +138,50 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         target = ServeEngine(graph, variables, faults=injector,
                              **engine_kwargs)
 
+    # multi-engine modes get a TelemetryHub: the merge point that
+    # stitches every replica's recorder/registry into ONE bundle and
+    # backs the live /metrics endpoint (docs/OBSERVABILITY.md
+    # "Distributed tracing"). Single-engine mode only builds one when
+    # the endpoint is requested — its on-disk bundle stays the
+    # schema-pinned single-recorder format.
+    hub = None
+    if disagg or replicas > 1 or metrics_port is not None:
+        from mmlspark_tpu.core.tracehub import TelemetryHub
+
+        hub = TelemetryHub()
+        if disagg:
+            hub.attach_fleet(target)
+        elif replicas > 1:
+            hub.attach_replicaset(target)
+        else:
+            hub.attach_engine(target)
+    server = None
+    if metrics_port is not None:
+        from mmlspark_tpu.core.tracehub import MetricsServer
+
+        server = MetricsServer(hub, port=metrics_port)
+
     rng = np.random.default_rng(seed)
     lo, hi = 4, max(5, min(16, cache_len - max_new_tokens))
     lengths = rng.integers(lo, hi + 1, size=n_requests)
     prompts = [rng.integers(0, vocab, size=int(p)) for p in lengths]
 
-    submitted = 0
-    results = {}
-    while submitted < n_requests or target.busy:
-        for _ in range(arrivals_per_tick):
-            if submitted < n_requests:
-                target.submit(
-                    prompts[submitted], max_new_tokens,
-                    deadline_ticks=deadline_ticks,
-                )
-                submitted += 1
-        for res in target.step():
-            results[res.id] = res
+    try:
+        submitted = 0
+        results = {}
+        while submitted < n_requests or target.busy:
+            for _ in range(arrivals_per_tick):
+                if submitted < n_requests:
+                    target.submit(
+                        prompts[submitted], max_new_tokens,
+                        deadline_ticks=deadline_ticks,
+                    )
+                    submitted += 1
+            for res in target.step():
+                results[res.id] = res
+    finally:
+        if server is not None:
+            server.close()
 
     if disagg or replicas > 1:
         out = target.metrics_dict()
@@ -167,34 +204,54 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         model_config={"vocab": vocab, "d_model": d_model, "heads": heads,
                       "depth": depth},
     )
+    if server is not None:
+        out["metrics_port"] = server.port
     if telemetry_dir:
-        from mmlspark_tpu.core.perf import export_chrome_trace
-
         os.makedirs(telemetry_dir, exist_ok=True)
-        # replica mode dumps the SUPERVISOR's recorder/registry (the
-        # control-plane timeline: routed/failover/hedge/drain events);
-        # each engine keeps its own recorder and registry — their
-        # perf.*/slo.* names are un-namespaced, so concatenating the
-        # engine expositions would collide
-        recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
-        with open(os.path.join(telemetry_dir, "metrics.json"), "w",
-                  encoding="utf-8") as f:
-            json.dump(out, f, indent=1, default=str)
-        # the full telemetry bundle: the Perfetto-loadable trace and
-        # the Prometheus text exposition land next to events/metrics
-        export_chrome_trace(
-            recorder,
-            path=os.path.join(telemetry_dir, "trace.json"),
-            extra_meta={"model": graph.name},
-        )
-        with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
-                  encoding="utf-8") as f:
-            f.write(registry.to_prometheus())
-    if trace_out:
-        from mmlspark_tpu.core.perf import export_chrome_trace
+        if hub is not None and (disagg or replicas > 1):
+            # the MERGED bundle: every replica's events/metrics plus
+            # the control plane's, stitched by the hub — the fix for
+            # the old behavior of dumping ONLY the supervisor's
+            # recorder and silently dropping per-engine telemetry.
+            # The control-plane-only timeline stays available as
+            # supervisor.events.jsonl for consumers of the old format.
+            hub.write_bundle(telemetry_dir, metrics=out)
+            recorder.dump(
+                os.path.join(telemetry_dir, "supervisor.events.jsonl")
+            )
+        else:
+            from mmlspark_tpu.core.perf import export_chrome_trace
+            from mmlspark_tpu.core.telemetry import (
+                atomic_write_json, atomic_write_text,
+            )
 
-        export_chrome_trace(recorder, path=trace_out,
-                            extra_meta={"model": graph.name})
+            # single-engine bundle: ONE recorder/registry, file formats
+            # pinned by tools/check_metrics_schema.py — writes go
+            # through the atomic helpers so a kill mid-dump can't
+            # leave a torn file
+            recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
+            atomic_write_json(
+                os.path.join(telemetry_dir, "metrics.json"), out,
+                indent=1, default=str,
+            )
+            export_chrome_trace(
+                recorder,
+                path=os.path.join(telemetry_dir, "trace.json"),
+                extra_meta={"model": graph.name},
+            )
+            atomic_write_text(
+                os.path.join(telemetry_dir, "metrics.prom"),
+                registry.to_prometheus(),
+            )
+    if trace_out:
+        if hub is not None and (disagg or replicas > 1):
+            hub.export_trace(path=trace_out,
+                             extra_meta={"model": graph.name})
+        else:
+            from mmlspark_tpu.core.perf import export_chrome_trace
+
+            export_chrome_trace(recorder, path=trace_out,
+                                extra_meta={"model": graph.name})
     return out
 
 
@@ -202,7 +259,8 @@ def _run_multimodel_demo(spec: str, *, n_requests: int,
                          max_new_tokens: int, arrivals_per_tick: int,
                          seed: int, device_budget: int | None,
                          injector, telemetry_dir: str | None,
-                         trace_out: str | None) -> dict:
+                         trace_out: str | None,
+                         metrics_port: int | None = None) -> dict:
     """The ``--models`` body: spec -> MultiModelEngine, then a
     deterministic interleaved arrival schedule — ``n_requests`` per
     deployment, token prompts for LM deployments and float feature
@@ -238,19 +296,35 @@ def _run_multimodel_demo(spec: str, *, n_requests: int,
         (name, *streams[name][i])
         for i in range(n_requests) for name in engine.models
     ]
-    submitted = 0
-    results = {}
-    while submitted < len(arrivals) or engine.busy:
-        for _ in range(arrivals_per_tick):
-            if submitted < len(arrivals):
-                name, x, budget = arrivals[submitted]
-                if budget is None:
-                    engine.submit(x, model=name)
-                else:
-                    engine.submit(x, model=name, max_new_tokens=budget)
-                submitted += 1
-        for res in engine.step():
-            results[res.id] = res
+    # the hub gives --models telemetry per-deployment {model="name"}
+    # labels (instead of model{name}. prefixes) and the live endpoint
+    from mmlspark_tpu.core.tracehub import TelemetryHub
+
+    hub = TelemetryHub()
+    hub.attach_multimodel(engine)
+    server = None
+    if metrics_port is not None:
+        from mmlspark_tpu.core.tracehub import MetricsServer
+
+        server = MetricsServer(hub, port=metrics_port)
+    try:
+        submitted = 0
+        results = {}
+        while submitted < len(arrivals) or engine.busy:
+            for _ in range(arrivals_per_tick):
+                if submitted < len(arrivals):
+                    name, x, budget = arrivals[submitted]
+                    if budget is None:
+                        engine.submit(x, model=name)
+                    else:
+                        engine.submit(x, model=name,
+                                      max_new_tokens=budget)
+                    submitted += 1
+            for res in engine.step():
+                results[res.id] = res
+    finally:
+        if server is not None:
+            server.close()
     out = engine.metrics_dict()
     out.update(
         n_requests=n_requests,
@@ -258,25 +332,11 @@ def _run_multimodel_demo(spec: str, *, n_requests: int,
         max_new_tokens=max_new_tokens,
         models_spec=spec,
     )
+    if server is not None:
+        out["metrics_port"] = server.port
     if telemetry_dir:
-        from mmlspark_tpu.core.perf import export_chrome_trace
-
-        os.makedirs(telemetry_dir, exist_ok=True)
-        engine.recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
-        with open(os.path.join(telemetry_dir, "metrics.json"), "w",
-                  encoding="utf-8") as f:
-            json.dump(out, f, indent=1, default=str)
-        export_chrome_trace(
-            engine.recorder,
-            path=os.path.join(telemetry_dir, "trace.json"),
-            extra_meta={"model": "multimodel"},
-        )
-        with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
-                  encoding="utf-8") as f:
-            f.write(engine.to_prometheus())
+        hub.write_bundle(telemetry_dir, metrics=out)
     if trace_out:
-        from mmlspark_tpu.core.perf import export_chrome_trace
-
-        export_chrome_trace(engine.recorder, path=trace_out,
-                            extra_meta={"model": "multimodel"})
+        hub.export_trace(path=trace_out,
+                         extra_meta={"model": "multimodel"})
     return out

@@ -690,7 +690,8 @@ class ServeEngine:
 
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: int | None = None,
-               deadline_ticks: int | None = None) -> int:
+               deadline_ticks: int | None = None,
+               trace_id: str | None = None) -> int:
         """Queue one request; returns its id. Raises
         :class:`FriendlyError` on invalid budgets or a full queue
         (admission control) — never a bare KeyError/ValueError.
@@ -698,6 +699,13 @@ class ServeEngine:
         ``deadline_ticks``: the request must FINISH within that many
         scheduler ticks of submission or it expires (queued or
         mid-decode), surfacing as status ``"expired"``.
+
+        ``trace_id``: fleet-wide trace-context id stamped on the
+        request's span and every hand-off payload derived from it
+        (docs/OBSERVABILITY.md "Distributed tracing"); supervisors
+        pass their global id here so one request's fragments across
+        replicas stay joinable. Default: the engine mints
+        ``t{request_id}``.
         """
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
@@ -749,6 +757,7 @@ class ServeEngine:
             ),
             submit_tick=self.tick,
             submit_wall=time.perf_counter(),
+            trace_id=trace_id or f"t{self._next_id}",
         )
         try:
             self._sched.enqueue(req)
@@ -762,7 +771,7 @@ class ServeEngine:
         self._next_id += 1
         self.metrics.record_submit()
         span = self._tracer.span(
-            "request", tick=self.tick, id=req.id,
+            "request", tick=self.tick, id=req.id, trace=req.trace_id,
             prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
         )
         span.event("queued", tick=self.tick, queue_depth=self.queue_depth)
@@ -1140,6 +1149,12 @@ class ServeEngine:
                         "kv": cache,
                         "max_new_tokens": req.max_new_tokens,
                         "eos_id": req.eos_id,
+                        # trace context rides the hand-off: the decode
+                        # replica's span carries the SAME id, which is
+                        # what lets the hub draw the prefill->decode
+                        # flow arrow (checksum covers only the
+                        # integrity-bearing fields, so this is free)
+                        "trace_id": req.trace_id,
                     }
                     # stamped at PRODUCTION: the adopting replica
                     # re-hashes before writing the cache into a slot,
@@ -1152,6 +1167,7 @@ class ServeEngine:
                     self._outbox.append(payload)
                     self.recorder.record(
                         "handoff_out", tick=tick, id=req.id, seq_len=p,
+                        trace=req.trace_id,
                     )
                     finished.append(
                         self._sched.handoff_result(req, first, tick)
@@ -1502,6 +1518,7 @@ class ServeEngine:
                 "prefix": np.asarray(req.prefix, np.int32),
                 "max_new_tokens": req.max_new_tokens,
                 "eos_id": req.eos_id,
+                "trace_id": req.trace_id,
             })
             span = self._spans.pop(req.id, None)
             if span is not None:
@@ -1512,7 +1529,8 @@ class ServeEngine:
         return out
 
     def adopt(self, prompt, *, prefix=(), max_new_tokens: int,
-              eos_id: int | None = None) -> int:
+              eos_id: int | None = None,
+              trace_id: str | None = None) -> int:
         """Admit a request MIGRATED from another replica (drain
         hand-off or failover re-route): ``prefix`` is the tokens the
         source replica already emitted, re-prefilled with the prompt so
@@ -1549,12 +1567,13 @@ class ServeEngine:
             submit_tick=self.tick,
             submit_wall=time.perf_counter(),
             prefix=prefix,
+            trace_id=trace_id or f"t{self._next_id}",
         )
         self._sched.queue.append(req)
         self._next_id += 1
         self.metrics.record_submit()
         span = self._tracer.span(
-            "request", tick=self.tick, id=req.id,
+            "request", tick=self.tick, id=req.id, trace=req.trace_id,
             prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
         )
         span.event("adopted", tick=self.tick, prefix_len=len(prefix))
@@ -1619,13 +1638,17 @@ class ServeEngine:
             submit_tick=self.tick,
             submit_wall=time.perf_counter(),
             prefix=prefix,
+            # the producing replica's trace context survives adoption:
+            # the continued stream's span here joins the prefill span
+            # there on one id
+            trace_id=str(payload.get("trace_id") or f"t{self._next_id}"),
         )
         self._sched.queue.append(req)
         self._handoffs[req.id] = dict(payload)
         self._next_id += 1
         self.metrics.record_submit()
         span = self._tracer.span(
-            "request", tick=self.tick, id=req.id,
+            "request", tick=self.tick, id=req.id, trace=req.trace_id,
             prompt_len=int(prompt.size), max_new_tokens=max_new_tokens,
         )
         span.event("handoff_queued", tick=self.tick,
@@ -1756,6 +1779,7 @@ class ServeEngine:
                 "eos_id": req.eos_id,
                 "deadline_tick": req.deadline_tick,
                 "submit_tick": req.submit_tick,
+                "trace": req.trace_id,
             })
         queued = []
         for req in self._sched.queue:
@@ -1767,6 +1791,7 @@ class ServeEngine:
                 "eos_id": req.eos_id,
                 "deadline_tick": req.deadline_tick,
                 "submit_tick": req.submit_tick,
+                "trace": req.trace_id,
             })
         snap = {
             "version": 1,
@@ -1844,11 +1869,17 @@ class ServeEngine:
                 submit_tick=int(entry["submit_tick"]),
                 submit_wall=now,
                 prefix=np.asarray(entry.get("emitted", ()), np.int32),
+                # the failover replay keeps the ORIGINAL trace id, so
+                # the re-prefill on the rebuilt engine is causally
+                # linked to the pre-crash submit in the merged trace
+                trace_id=str(entry.get("trace")
+                             or f"t{int(entry['id'])}"),
             )
             engine._sched.queue.append(req)
             engine.metrics.record_submit()
             span = engine._tracer.span(
                 "request", tick=engine.tick, id=req.id,
+                trace=req.trace_id,
                 prompt_len=int(req.prompt.size),
                 max_new_tokens=req.max_new_tokens,
             )

@@ -186,6 +186,11 @@ class _Pending:
     #: (refcounted on the entry until the request commits)
     index_key: bytes | None = None
     committed: bool = False
+    #: fleet-wide trace-context id (``f{gid}``): stamped on the prefill
+    #: submit, carried by the hand-off payload onto the decode replica
+    #: and by every failover replay / drain migration — the id the
+    #: hub's cross-replica flow arrows bind on
+    trace_id: str = ""
 
 
 @dataclass
@@ -469,6 +474,7 @@ class DisaggFleet:
             gid=gid, prompt=prompt, max_new_tokens=max_new_tokens,
             eos_id=eos_id, deadline_ticks=deadline_ticks,
             submit_t=self._clock(), submit_tick=self._tick,
+            trace_id=f"f{gid}",
         )
         entry = self._index.get(prompt.tobytes())
         if entry is not None and len(prompt) == entry.length:
@@ -490,13 +496,14 @@ class DisaggFleet:
             )
             rid = target.engine.submit(
                 prompt, max_new_tokens, eos_id=eos_id,
-                deadline_ticks=deadline_ticks,
+                deadline_ticks=deadline_ticks, trace_id=p.trace_id,
             )
             target.routed[rid] = gid
             p.copies = [_Copy(target.idx, rid)]
             self.recorder.record(
                 "routed", tick=self._tick, gid=gid,
                 replica=target.idx, rid=rid, stage="prefill",
+                trace=p.trace_id,
             )
         self._next_gid += 1
         self._requests[gid] = p
@@ -522,6 +529,9 @@ class DisaggFleet:
             # re-spelling (full seq as prompt, empty prefix) still
             # verifies on adopt
             "checksum": entry.checksum,
+            # THIS request's trace context, not the producer's: the
+            # index entry is shared, the causal chain is per-request
+            "trace_id": p.trace_id,
         }
         target = self._adopt_on_decode(p.gid, payload,
                                        prefer=set(entry.home))
@@ -537,6 +547,7 @@ class DisaggFleet:
         self.recorder.record(
             "fleet_prefix_hit", tick=self._tick, gid=p.gid,
             replica=target.idx, tokens_saved=int(entry.length),
+            trace=p.trace_id,
         )
 
     def _index_insert(self, pay: dict) -> bytes:
@@ -628,6 +639,7 @@ class DisaggFleet:
             "handoff_routed", tick=self._tick, gid=gid,
             replica=target.idx, rid=rid,
             seq_len=int(payload["length"]),
+            trace=str(payload.get("trace_id", "")),
         )
         return target
 
@@ -807,7 +819,7 @@ class DisaggFleet:
             p = self._requests[gid]
             new_rid = eng.adopt(
                 p.prompt, max_new_tokens=p.max_new_tokens,
-                eos_id=p.eos_id,
+                eos_id=p.eos_id, trace_id=p.trace_id,
             )
             new_routed[new_rid] = gid
             for c in p.copies:
@@ -861,6 +873,7 @@ class DisaggFleet:
                     pay["prompt"], prefix=pay["prefix"],
                     max_new_tokens=pay["max_new_tokens"],
                     eos_id=pay["eos_id"],
+                    trace_id=pay.get("trace_id") or None,
                 )
                 target.routed[new_rid] = gid
                 p = self._requests[gid]
@@ -872,6 +885,7 @@ class DisaggFleet:
                     "migrated", tick=self._tick, gid=gid,
                     src=rep.idx, dst=target.idx,
                     prefix_len=len(pay["prefix"]),
+                    trace=pay.get("trace_id", ""),
                 )
         if not rep.engine.busy and not rep.routed:
             self._retire(rep)
@@ -1081,6 +1095,7 @@ class DisaggFleet:
                 "emitted": emitted.get(gid, []),
                 "max_new_tokens": p.max_new_tokens,
                 "eos_id": p.eos_id,
+                "trace": p.trace_id,
             })
         return {
             "version": 1,
@@ -1128,6 +1143,7 @@ class DisaggFleet:
                 max_new_tokens=int(entry["max_new_tokens"]),
                 eos_id=entry["eos_id"], deadline_ticks=None,
                 submit_t=fleet._clock(), submit_tick=fleet._tick,
+                trace_id=str(entry.get("trace") or f"f{gid}"),
             )
             # emitted tokens resume through adopt (prefix re-prefill);
             # fresh requests route through the normal prefill path
@@ -1138,7 +1154,7 @@ class DisaggFleet:
             rid = target.engine.adopt(
                 prompt, prefix=prefix,
                 max_new_tokens=int(entry["max_new_tokens"]),
-                eos_id=entry["eos_id"],
+                eos_id=entry["eos_id"], trace_id=p.trace_id,
             )
             target.routed[rid] = gid
             p.copies = [_Copy(target.idx, rid)]

@@ -656,6 +656,11 @@ class MultiModelEngine:
         engine hosts exactly one deployment."""
         name = self._resolve(model)
         dep = self._deployments[name]
+        # minted BEFORE the deployment call (the gid is consumed only
+        # on success): LM spans on the shared recorder carry the
+        # facade-level trace id, so the hub's per-model chains join
+        # the "routed" control event to the engine span
+        trace = f"m{self._next_gid}"
         if isinstance(dep, ServeEngine):
             if max_new_tokens is None:
                 raise FriendlyError(
@@ -664,7 +669,7 @@ class MultiModelEngine:
                 )
             lid = dep.submit(
                 x, max_new_tokens, eos_id=eos_id,
-                deadline_ticks=deadline_ticks,
+                deadline_ticks=deadline_ticks, trace_id=trace,
             )
         else:
             if (max_new_tokens is not None or eos_id is not None
@@ -681,6 +686,7 @@ class MultiModelEngine:
         self._model_of[gid] = name
         self.recorder.record(
             "routed", tick=self._tick, model=name, gid=gid, rid=lid,
+            trace=trace,
         )
         return gid
 

@@ -53,6 +53,13 @@ class ServeRequest:
     #: stopped (greedy determinism keeps the stream bit-identical).
     #: Counts against ``max_new_tokens``.
     prefix: np.ndarray = field(default_factory=lambda: _EMPTY_PREFIX)
+    #: fleet-wide trace-context id (docs/OBSERVABILITY.md "Distributed
+    #: tracing"): stamped at the FIRST submit and carried verbatim
+    #: through routing, hedge twins, hand-off payloads, failover
+    #: replays and drain migrations — every recorder event/span the
+    #: request touches on any replica is joinable on it. "" = unstamped
+    #: (pre-tracing callers); the engine then mints ``t{id}``.
+    trace_id: str = ""
 
 
 @dataclass

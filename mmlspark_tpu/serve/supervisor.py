@@ -98,6 +98,10 @@ class _Pending:
     copies: list[_Copy] = field(default_factory=list)
     hedged: bool = False
     committed: bool = False
+    #: fleet-wide trace-context id (``g{gid}``): every copy — hedge
+    #: twins, failover replays, drain migrations — submits with it, so
+    #: the hub can stitch all of a request's fragments across replicas
+    trace_id: str = ""
 
 
 @dataclass
@@ -363,11 +367,15 @@ class ReplicaSet:
             )
         target = next((r for r in order if not r.engine.queue_full),
                       order[0])
+        # the trace id is minted BEFORE the engine call (the gid is
+        # only consumed on success, so a rejected submit re-mints the
+        # same id for the next request — no gap, no collision)
+        trace = f"g{self._next_gid}"
         # target.engine.submit validates and may reject (queue full on
         # EVERY replica -> the best one's canonical rejection)
         rid = target.engine.submit(
             prompt, max_new_tokens, eos_id=eos_id,
-            deadline_ticks=deadline_ticks,
+            deadline_ticks=deadline_ticks, trace_id=trace,
         )
         gid = self._next_gid
         self._next_gid += 1
@@ -382,11 +390,12 @@ class ReplicaSet:
             submit_tick=self._tick,
             model=model,
             copies=[_Copy(target.idx, rid)],
+            trace_id=trace,
         )
         self._open.add(gid)
         self.recorder.record(
             "routed", tick=self._tick, gid=gid, replica=target.idx,
-            rid=rid, model=model,
+            rid=rid, model=model, trace=trace,
         )
         return gid
 
@@ -571,7 +580,7 @@ class ReplicaSet:
             p = self._requests[gid]
             new_rid = eng.adopt(
                 p.prompt, max_new_tokens=p.max_new_tokens,
-                eos_id=p.eos_id,
+                eos_id=p.eos_id, trace_id=p.trace_id,
             )
             new_routed[new_rid] = gid
             for c in p.copies:
@@ -612,8 +621,12 @@ class ReplicaSet:
             if target is None:
                 continue  # nowhere to hedge right now; retry next tick
             try:
+                # the twin carries the SAME trace id: in the merged
+                # trace both copies hang off one causal chain and the
+                # loser is visibly the hedge that lost
                 rid = target.engine.submit(
                     p.prompt, p.max_new_tokens, eos_id=p.eos_id,
+                    trace_id=p.trace_id,
                 )
             except FriendlyError:
                 continue
@@ -624,6 +637,7 @@ class ReplicaSet:
             self.recorder.record(
                 "hedge", tick=self._tick, gid=gid, replica=target.idx,
                 age_ms=round((now - p.submit_t) * 1e3, 3),
+                trace=p.trace_id,
             )
 
     # -- drain -------------------------------------------------------------
@@ -663,6 +677,7 @@ class ReplicaSet:
                     pay["prompt"], prefix=pay["prefix"],
                     max_new_tokens=pay["max_new_tokens"],
                     eos_id=pay["eos_id"],
+                    trace_id=pay.get("trace_id") or None,
                 )
                 target.routed[new_rid] = gid
                 p = self._requests[gid]
@@ -674,6 +689,7 @@ class ReplicaSet:
                     "migrated", tick=self._tick, gid=gid,
                     src=rep.idx, dst=target.idx,
                     prefix_len=len(pay["prefix"]),
+                    trace=pay.get("trace_id", ""),
                 )
         if not rep.engine.busy and not rep.routed:
             self._retire(rep)

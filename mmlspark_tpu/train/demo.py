@@ -24,7 +24,6 @@ Prometheus text exposition in ``metrics.prom`` — the schema
 
 from __future__ import annotations
 
-import json
 import os
 import tempfile
 
@@ -119,12 +118,20 @@ def run_train_demo(*, epochs: int = 2, batch_size: int = 32,
     if injector is not None:
         out["faults_injected"] = dict(injector.counts)
     if telemetry_dir:
+        from mmlspark_tpu.core.telemetry import (
+            atomic_write_json, atomic_write_text,
+        )
+
+        # same tmp-file + os.replace commit point as the checkpoint
+        # store: a kill mid-dump never leaves a torn telemetry file
         os.makedirs(telemetry_dir, exist_ok=True)
         recorder.dump(os.path.join(telemetry_dir, "events.jsonl"))
-        with open(os.path.join(telemetry_dir, "metrics.json"), "w",
-                  encoding="utf-8") as f:
-            json.dump(out, f, indent=1, default=str)
-        with open(os.path.join(telemetry_dir, "metrics.prom"), "w",
-                  encoding="utf-8") as f:
-            f.write(registry.to_prometheus())
+        atomic_write_json(
+            os.path.join(telemetry_dir, "metrics.json"), out,
+            indent=1, default=str,
+        )
+        atomic_write_text(
+            os.path.join(telemetry_dir, "metrics.prom"),
+            registry.to_prometheus(),
+        )
     return out

@@ -561,8 +561,10 @@ def test_run_demo_multimodel(tmp_path):
     assert {e["attrs"]["model"] for e in routed} == {"lm", "clf"}
     with open(os.path.join(tel, "metrics.prom")) as f:
         prom = f.read()
-    assert "modellm_serve_ttft_ms" in prom
-    assert "modelclf_serve_ttft_ms" in prom
+    # the telemetry dir carries the MERGED TelemetryHub exposition:
+    # per-model prefixes become {model=...} labels on shared families
+    assert 'serve_ttft_ms_count{model="lm"}' in prom
+    assert 'serve_ttft_ms_count{model="clf"}' in prom
 
 
 # -- replica routing with a model dimension --------------------------------

@@ -25,6 +25,7 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu.core.exceptions import FriendlyError
+from mmlspark_tpu.core.integrity import SnapshotCorruption
 from mmlspark_tpu.core.faults import (
     Fault,
     FaultInjector,
@@ -368,10 +369,15 @@ def test_restore_guards(lm):
     m, v, _ = lm
     engine = ServeEngine(m, v, slots=2, cache_len=32)
     snap = engine.snapshot()
-    with pytest.raises(FriendlyError, match="version"):
+    # a tampered-but-stamped snapshot trips the checksum guard before
+    # the version/model guards ever run
+    with pytest.raises(SnapshotCorruption, match="checksum"):
         ServeEngine.restore({**snap, "version": 99}, m, v)
+    unstamped = {k: val for k, val in snap.items() if k != "checksum"}
+    with pytest.raises(FriendlyError, match="version"):
+        ServeEngine.restore({**unstamped, "version": 99}, m, v)
     with pytest.raises(FriendlyError, match="model"):
-        ServeEngine.restore({**snap, "model": "other_lm"}, m, v)
+        ServeEngine.restore({**unstamped, "model": "other_lm"}, m, v)
     # idle snapshot restores to an idle engine
     rebuilt = ServeEngine.restore(snap, m, v, slots=2)
     assert not rebuilt.busy and rebuilt.tick == engine.tick

@@ -44,6 +44,19 @@ Exits non-zero with a pointed message on the first violation, so
     python tools/check_metrics_schema.py --disagg      # fleet surface
     python tools/check_metrics_schema.py --train       # training surface
     python tools/check_metrics_schema.py --multi-model # model-zoo surface
+    python tools/check_metrics_schema.py --tracing     # distributed tracing
+
+Replicated/disagg/multi-model runs write the MERGED TelemetryHub
+bundle (docs/OBSERVABILITY.md "Distributed tracing"): the
+``events.jsonl`` header is ``telemetry_hub`` naming every source, the
+exposition uses ``{replica="0",role="prefill"}`` labels instead of
+name prefixes, ``metrics.json`` carries a ``hub`` summary block with
+the full ``alerts.*`` catalog, and ``trace.json`` holds
+``trace_id``-bound flow arrows. ``--tracing`` is the acceptance drill:
+a seeded ``--disagg --faults`` run must produce ONE merged trace where
+a handed-off request's flow arrow crosses the prefill -> decode
+replica tracks AND a killed replica's failover replay links to the
+original submit via the same trace id (a ``#1``-generation track).
 """
 
 from __future__ import annotations
@@ -272,6 +285,15 @@ REQUIRED_FLEET_PER_REPLICA_KEYS: dict[str, tuple] = {
 #: pins their presence in a demo run's events.jsonl
 REQUIRED_EVENT_NAMES = {"dispatch", "tick"}
 
+#: the hub's full alert catalog (core/tracehub.ALERT_KINDS) — every
+#: ``alerts.*`` counter must exist from tick zero, in the exposition
+#: AND the metrics.json ``hub`` block, so dashboards never need
+#: existence checks before alerting on them
+HUB_ALERT_KINDS = (
+    "retrace_storm", "host_sync_regression", "queue_watermark",
+    "tick_p99_drift", "slo_burn_spread",
+)
+
 # the train CLI's one-line contract (docs/TRAINING.md "Observability"):
 # SPMDTrainer's registry flattened by MetricRegistry.to_dict() plus the
 # demo's run summary. Counters are ints; histogram leaves are the
@@ -469,6 +491,109 @@ def check_trace(path: str, n_requests: int) -> int:
     return len(events)
 
 
+def check_hub_bundle(tdir: str, label: str,
+                     want_sources: tuple) -> list:
+    """Shared assertions on a TelemetryHub-merged ``--telemetry-dir``
+    bundle: the ``telemetry_hub`` events header naming every expected
+    source, the pre-registered ``alerts_*`` counters in the labeled
+    exposition, the ``hub`` summary block in ``metrics.json``, and the
+    supervisor/fleet compat dump. Returns the merged event lines."""
+    epath = os.path.join(tdir, "events.jsonl")
+    try:
+        lines = open(epath, encoding="utf-8").read().splitlines()
+    except OSError as e:
+        fail(f"{label} events.jsonl unreadable: {e}")
+    try:
+        header = json.loads(lines[0])
+    except (IndexError, json.JSONDecodeError) as e:
+        fail(f"{label} events.jsonl header unreadable: {e}")
+    if header.get("header") != "telemetry_hub":
+        fail(
+            f"{label} events.jsonl must open with the telemetry_hub "
+            f"header (the MERGED bundle), got {header}"
+        )
+    missing = set(want_sources) - set(header.get("sources", []))
+    if missing:
+        fail(f"{label} hub header lacks sources {sorted(missing)}: "
+             f"{header.get('sources')}")
+    anchors = header.get("t0_unix")
+    if not isinstance(anchors, dict) or not all(
+            isinstance(v, (int, float)) for v in anchors.values()):
+        fail(f"{label} hub header lacks per-source t0_unix anchors: "
+             f"{anchors!r}")
+    for ev_line in lines[1:]:
+        try:
+            ev = json.loads(ev_line)
+        except json.JSONDecodeError as e:
+            fail(f"{label} events.jsonl malformed line: {e}")
+        for key in ("src", "wall", "t", "name"):
+            if key not in ev:
+                fail(f"{label} merged event lacks {key!r}: {ev}")
+    prom = open(os.path.join(tdir, "metrics.prom"),
+                encoding="utf-8").read()
+    for kind in HUB_ALERT_KINDS:
+        if f"alerts_{kind}_total" not in prom:
+            fail(f"{label} metrics.prom lacks the pre-registered "
+                 f"alerts_{kind}_total counter")
+    mpath = os.path.join(tdir, "metrics.json")
+    try:
+        hub = json.load(open(mpath, encoding="utf-8")).get("hub")
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"{label} metrics.json unreadable: {e}")
+    if not isinstance(hub, dict):
+        fail(f"{label} metrics.json lacks the hub summary block")
+    if set(hub.get("alerts", {})) != set(HUB_ALERT_KINDS):
+        fail(f"{label} hub block's alert catalog is incomplete: "
+             f"{sorted(hub.get('alerts', {}))}")
+    missing = set(want_sources) - set(hub.get("sources", []))
+    if missing:
+        fail(f"{label} hub block lacks sources {sorted(missing)}")
+    # the control plane's own recorder survives as a compat dump in
+    # the old single-recorder format
+    for compat in ("supervisor.events.jsonl",):
+        cpath = os.path.join(tdir, compat)
+        if not os.path.exists(cpath):
+            continue
+        chead = json.loads(open(cpath, encoding="utf-8").readline())
+        if chead.get("header") != "flight_recorder":
+            fail(f"{label} {compat} lost the flight_recorder format: "
+                 f"{chead}")
+    return lines
+
+
+def load_flow_chains(tdir: str, label: str) -> dict:
+    """``trace_id -> [(ph, source name, tid)]`` from a merged
+    trace.json's flow arrows (``ph`` s/t/f), ts-ordered."""
+    tpath = os.path.join(tdir, "trace.json")
+    try:
+        doc = json.load(open(tpath, encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"{label} trace.json unreadable: {e}")
+    pname = {
+        ev["pid"]: ev["args"]["name"]
+        for ev in doc["traceEvents"]
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    chains: dict = {}
+    for ev in sorted(
+            (e for e in doc["traceEvents"] if e.get("ph") in "stf"),
+            key=lambda e: e["ts"]):
+        if ev.get("cat") != "request" or "id" not in ev:
+            fail(f"{label} flow event lacks cat/id binding: {ev}")
+        if ev["ph"] == "f" and ev.get("bp") != "e":
+            fail(f"{label} flow finish not bound to enclosing slice "
+                 f"(bp != 'e'): {ev}")
+        chains.setdefault(ev["id"], []).append(
+            (ev["ph"], pname.get(ev["pid"], f"pid{ev['pid']}"),
+             ev["tid"])
+        )
+    for trace, hops in chains.items():
+        phases = [ph for ph, _, _ in hops]
+        if phases[0] != "s" or phases[-1] != "f":
+            fail(f"{label} flow chain {trace} malformed: {phases}")
+    return chains
+
+
 def check_replica_mode(env: dict, repo: str) -> None:
     """Second smoke run with ``--replicas 2``: the JSON line switches to
     ``ReplicaSet.metrics_dict()`` and the telemetry bundle to the
@@ -537,20 +662,23 @@ def check_replica_mode(env: dict, repo: str) -> None:
         prom = open(ppath, encoding="utf-8").read()
         for needle in ("serve_replica_failovers_total", "serve_hedges_total",
                        "serve_hedge_wasted_tokens_total",
-                       "serve_drains_total"):
+                       "serve_drains_total",
+                       # per-replica engine series fold into ONE family
+                       # told apart by labels, not name prefixes
+                       'serve_completed_total{replica="0"}',
+                       'serve_completed_total{replica="1"}',
+                       'serve_ttft_ms_count{replica="0"}'):
             if needle not in prom:
                 fail(f"--replicas metrics.prom lacks {needle!r}")
-        epath = os.path.join(tdir, "events.jsonl")
-        try:
-            lines = open(epath, encoding="utf-8").read().splitlines()
-        except OSError as e:
-            fail(f"--replicas events.jsonl unreadable: {e}")
-        names = set()
-        for line in lines[1:]:
-            try:
-                names.add(json.loads(line)["name"])
-            except (json.JSONDecodeError, KeyError) as e:
-                fail(f"--replicas events.jsonl malformed line: {e}")
+        lines = check_hub_bundle(
+            tdir, "--replicas",
+            ("hub", "supervisor", "replica0", "replica1"),
+        )
+        if not os.path.exists(
+                os.path.join(tdir, "supervisor.events.jsonl")):
+            fail("--replicas bundle lacks the supervisor.events.jsonl "
+                 "compat dump")
+        names = {json.loads(line)["name"] for line in lines[1:]}
         if "routed" not in names:
             fail(
                 "--replicas events.jsonl lacks 'routed' control-plane "
@@ -656,26 +784,40 @@ def check_disagg_mode(env: dict, repo: str) -> None:
                        "serve_fleet_prefill_tokens_saved_total",
                        "serve_scale_ups_total", "serve_scale_downs_total",
                        "serve_replica_failovers_total",
-                       "serve_drains_total"):
+                       "serve_drains_total",
+                       # per-engine series labeled by replica AND role
+                       'serve_completed_total{replica="0",role="prefill"}',
+                       'serve_ttft_ms_count{replica="1",role="decode"}'):
             if needle not in prom:
                 fail(f"--disagg metrics.prom lacks {needle!r}")
-        epath = os.path.join(tdir, "events.jsonl")
-        try:
-            lines = open(epath, encoding="utf-8").read().splitlines()
-        except OSError as e:
-            fail(f"--disagg events.jsonl unreadable: {e}")
-        names = set()
-        for line in lines[1:]:
-            try:
-                names.add(json.loads(line)["name"])
-            except (json.JSONDecodeError, KeyError) as e:
-                fail(f"--disagg events.jsonl malformed line: {e}")
+        lines = check_hub_bundle(
+            tdir, "--disagg",
+            ("hub", "fleet", "prefill0", "decode1", "decode2"),
+        )
+        if not os.path.exists(
+                os.path.join(tdir, "supervisor.events.jsonl")):
+            fail("--disagg bundle lacks the supervisor.events.jsonl "
+                 "compat dump")
+        names = {json.loads(line)["name"] for line in lines[1:]}
         for needle in ("routed", "handoff_routed"):
             if needle not in names:
                 fail(
                     f"--disagg events.jsonl lacks {needle!r} "
                     f"control-plane events (names seen: {sorted(names)})"
                 )
+        # every hand-off is a multi-fragment request: the merged trace
+        # must stitch it with a flow arrow crossing replica tracks
+        chains = load_flow_chains(tdir, "--disagg")
+        crossed = [
+            t for t, hops in chains.items()
+            if {src for _, src, _ in hops} >= {"prefill0"}
+            and any(src.startswith("decode") for _, src, _ in hops)
+        ]
+        if not crossed:
+            fail(
+                "--disagg trace.json has no flow arrow crossing the "
+                f"prefill0 -> decode tracks (chains: {chains})"
+            )
     print(
         f"check_metrics_schema: OK — --disagg line carries "
         f"{len(REQUIRED_FLEET_KEYS)} fleet keys, "
@@ -839,22 +981,24 @@ def check_multimodel_mode(env: dict, repo: str) -> None:
         if not os.path.exists(ppath):
             fail("--models --telemetry-dir did not produce metrics.prom")
         prom = open(ppath, encoding="utf-8").read()
-        for needle in ("modellm_serve_ttft_ms",
-                       "modelclf_serve_ttft_ms",
-                       "modelox_serve_ttft_ms",
-                       "modellm_serve_completed_total",
-                       "modelclf_serve_completed_total",
-                       "modelox_serve_completed_total"):
+        # the hub translates the shared registry's model{name}. name
+        # prefixes into ONE serve_* family per metric with model labels
+        for needle in ('serve_ttft_ms_count{model="lm"}',
+                       'serve_ttft_ms_count{model="clf"}',
+                       'serve_ttft_ms_count{model="ox"}',
+                       'serve_completed_total{model="lm"}',
+                       'serve_completed_total{model="clf"}',
+                       'serve_completed_total{model="ox"}'):
             if needle not in prom:
                 fail(f"--models metrics.prom lacks {needle!r}")
         samples = [
-            ln.split()[0] for ln in prom.splitlines()
+            ln.split(" ")[0] for ln in prom.splitlines()
             if ln and not ln.startswith("#")
         ]
         if len(samples) != len(set(samples)):
             dupes = sorted({s for s in samples if samples.count(s) > 1})
             fail(f"--models metrics.prom has duplicate sample lines "
-                 f"(namespace collision): {dupes[:5]}")
+                 f"(label collision): {dupes[:5]}")
         mpath = os.path.join(tdir, "metrics.json")
         if not os.path.exists(mpath):
             fail("--models --telemetry-dir did not produce metrics.json")
@@ -862,21 +1006,17 @@ def check_multimodel_mode(env: dict, repo: str) -> None:
         missing = set(REQUIRED_MULTIMODEL_KEYS) - set(persisted)
         if missing:
             fail(f"--models metrics.json lacks keys {missing}")
-        epath = os.path.join(tdir, "events.jsonl")
-        try:
-            lines = open(epath, encoding="utf-8").read().splitlines()
-        except OSError as e:
-            fail(f"--models events.jsonl unreadable: {e}")
+        lines = check_hub_bundle(
+            tdir, "--models",
+            ("hub", "multimodel", "model:lm", "model:clf", "model:ox"),
+        )
         names = set()
         routed_models = set()
         for line in lines[1:]:
-            try:
-                ev = json.loads(line)
-                names.add(ev["name"])
-                if ev["name"] == "routed":
-                    routed_models.add(ev.get("attrs", {}).get("model"))
-            except (json.JSONDecodeError, KeyError) as e:
-                fail(f"--models events.jsonl malformed line: {e}")
+            ev = json.loads(line)
+            names.add(ev["name"])
+            if ev["name"] == "routed":
+                routed_models.add(ev.get("attrs", {}).get("model"))
         for needle in ("deployment_added", "routed", "batch_dispatch"):
             if needle not in names:
                 fail(
@@ -895,6 +1035,96 @@ def check_multimodel_mode(env: dict, repo: str) -> None:
         f"deployments; {md['completed']} requests completed under one "
         f"device budget; model{{name}} namespaces collision-free in "
         f"the exposition"
+    )
+
+
+def check_tracing_mode(env: dict, repo: str) -> None:
+    """Distributed-tracing acceptance drill (``--tracing``): a SEEDED
+    ``--disagg --faults`` run under replica kills. The merged bundle
+    must stitch every request into one causal chain — the hand-off's
+    flow arrow crossing the prefill -> decode replica tracks, and a
+    killed replica's failover replay joining the ORIGINAL submit's
+    trace id on a rebuilt (``#1``-generation) track
+    (docs/OBSERVABILITY.md "Distributed tracing")."""
+    with tempfile.TemporaryDirectory() as tdir:
+        cmd = [
+            sys.executable, "-m", "mmlspark_tpu", "--cpu-mesh", "4",
+            "serve", "--demo", "--slots", "2",
+            "--requests", "6", "--max-new-tokens", "6",
+            "--disagg", "--prefill-replicas", "1",
+            "--decode-replicas", "2",
+            "--faults", "seed=7,serve.health:kill=0.35",
+            "--telemetry-dir", tdir,
+        ]
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env=env, cwd=repo,
+        )
+        if res.returncode != 0:
+            fail(f"serve --demo --disagg --faults exited "
+                 f"{res.returncode}:\n{res.stderr}")
+        out_lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+        if len(out_lines) != 1:
+            fail(f"--tracing stdout must be exactly ONE JSON line, got "
+                 f"{len(out_lines)}:\n{res.stdout}")
+        md = json.loads(out_lines[0])
+        if md["completed"] != 6:
+            fail(f"--tracing drill must complete all 6 requests "
+                 f"through the kills, got {md['completed']}")
+        if md["replica_failovers_total"] < 1:
+            fail("--tracing drill's seeded kill spec fired no failover")
+        if md["handoffs_total"] < 1:
+            fail("--tracing drill routed no hand-off payloads")
+        lines = check_hub_bundle(
+            tdir, "--tracing", ("hub", "fleet", "prefill0"),
+        )
+        header = json.loads(lines[0])
+        rebuilt = [s for s in header["sources"] if "#" in s]
+        if not rebuilt:
+            fail(
+                "--tracing hub header shows no rebuilt-engine "
+                f"generation (a '#1' source): {header['sources']}"
+            )
+        chains = load_flow_chains(tdir, "--tracing")
+        if not chains:
+            fail("--tracing trace.json holds no flow arrows at all")
+        crossed = [
+            t for t, hops in chains.items()
+            if any(src == "prefill0" for _, src, _ in hops)
+            and any(src.startswith("decode") for _, src, _ in hops)
+        ]
+        if not crossed:
+            fail(
+                "--tracing: no flow arrow crosses the prefill0 -> "
+                f"decode replica tracks (chains: {chains})"
+            )
+        replayed = [
+            t for t, hops in chains.items()
+            if any("#" in src for _, src, _ in hops)
+        ]
+        if not replayed:
+            fail(
+                "--tracing: no failover replay joined its original "
+                "trace id on a rebuilt-engine track (chains: "
+                f"{chains})"
+            )
+        # the replayed chain's arrow STARTS before the kill — same
+        # trace id binds the original submit's fragment to the rebuilt
+        # engine's, which is the whole point of propagation
+        for t in replayed:
+            first_ph, first_src, _ = chains[t][0]
+            if first_ph != "s" or "#" in first_src:
+                fail(
+                    f"--tracing: replayed chain {t} does not start "
+                    f"from a pre-kill fragment: {chains[t]}"
+                )
+    print(
+        f"check_metrics_schema: OK — --tracing drill completed 6/6 "
+        f"requests through {md['replica_failovers_total']} failover(s); "
+        f"{len(chains)} flow chain(s) in the merged trace, "
+        f"{len(crossed)} crossing prefill -> decode tracks, "
+        f"{len(replayed)} linking a failover replay to its original "
+        f"submit via the same trace id (rebuilt sources: {rebuilt})"
     )
 
 
@@ -1170,6 +1400,10 @@ def main() -> None:
     if "--multi-model" in sys.argv[1:]:
         # the multi-model gate runs the serve --models surface on its own
         check_multimodel_mode(env, repo)
+        return
+    if "--tracing" in sys.argv[1:]:
+        # the distributed-tracing gate: seeded disagg + faults drill
+        check_tracing_mode(env, repo)
         return
     with tempfile.TemporaryDirectory() as tdir:
         # --mesh makes the run exercise the SHARDED engine, so the gate

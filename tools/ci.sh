@@ -141,6 +141,10 @@ print("integrity drill: OK —",
   step "telemetry schema gate (serve --demo artifacts)"
   python tools/check_metrics_schema.py
 
+  step "distributed tracing gate (TelemetryHub merge / flow arrows / alerts)"
+  python -m pytest tests/test_tracehub.py -q
+  python tools/check_metrics_schema.py --tracing
+
   step "bench regression gate (selftest vs the recorded BENCH history)"
   # proves the tolerance-band logic on the REAL history: the newest
   # usable entry must pass, a 25% injected slowdown must fail — no
