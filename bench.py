@@ -119,6 +119,7 @@ _GROUPS = {
     "serve": ("serve",),
     "serve_sharded": ("serve_sharded",),
     "serve_faults": ("serve_faults",),
+    "serve_chunked": ("serve_chunked",),
     "serve_paged": ("serve_paged",),
     "serve_int8": ("serve_int8",),
     "serve_supervisor": ("serve_supervisor",),
@@ -922,6 +923,253 @@ def bench_serve_faults(jax) -> dict:
     out["timing"] = ("full ServeEngine drive per config, warm-up then "
                      "best-of-3; chaos via run_demo at seeded rates")
     return {"serve_faults": out}
+
+
+def bench_serve_chunked(jax) -> dict:
+    """Chunked prefill + async host loop proof (docs/PERFORMANCE.md
+    "Chunked prefill & async host loop"): a mixed long/short-prompt
+    open-loop workload through four engine configs — monolithic/sync
+    (baseline), chunked/sync, monolithic/async, chunked+async — at
+    equal device count and identical traffic. Four claims, one group:
+
+    - head-of-line blocking: short interactive requests queued behind a
+      long prompt's fill see their TTFT drop when the fill is chunked
+      (``ttft_short_p50_ms_*``; the ``ttft_short_p50_ratio`` budget is
+      the embedded no-regression gate at full scale). Overall p99
+      rides along for context — it is dominated by the LONG prompts'
+      own first tokens, the latency chunking deliberately spreads out;
+    - steady-state throughput holds: ``tokens_per_sec_*`` per config
+      (history-banded by tools/bench_regression.py) plus the
+      ``tps_drop_pct`` budget (full scale) pinning
+      chunked+async against the monolithic/sync baseline in-run;
+    - the async loop actually overlaps: ``host_idle_fraction_*``
+      (blocked-in-device_get wall share) must not grow async-vs-sync
+      (``host_idle_ratio`` budget, full scale), and
+      ``overlapped_dispatches`` counts the blocks dispatched behind an
+      in-flight predecessor;
+    - bit-identity is not negotiable: all four configs must emit
+      byte-equal token streams (``stream_mismatches`` budget 0,
+      everywhere).
+
+    Compile pins gate everywhere too: chunked configs must keep
+    ``prefill_compiles <= chunk_bucket_count``
+    (``prefill_compile_excess`` budget 0)."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.serve import ServeEngine
+
+    full = _full_scale(jax)
+    vocab, d_model, heads, depth = (
+        (8192, 512, 8, 8) if full else (64, 64, 2, 4)
+    )
+    cache_len = 256 if full else 64
+    chunk = 32 if full else 8
+    slots = 8
+    max_new = 24 if full else 4
+    long_len, short_len = (160, 12) if full else (48, 6)
+    n_groups = 6 if full else 4
+    group_gap = 4
+    graph = build_model(
+        "transformer_lm", vocab_size=vocab, d_model=d_model, heads=heads,
+        depth=depth, max_len=cache_len,
+    )
+    variables = graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    # one long prompt plus three shorts arriving TOGETHER, a new group
+    # every ``group_gap`` ticks: every long fill has same-tick shorts
+    # behind it — the head-of-line scenario chunking exists to fix.
+    # Arrivals are PACED (slots sized so the queue never saturates):
+    # under saturation TTFT measures queue depth, not fill blocking,
+    # and the comparison would say nothing about prefill policy
+    rng = np.random.default_rng(17)
+    lengths = []
+    for _ in range(n_groups):
+        lengths.extend([long_len, short_len, short_len, short_len])
+    prompts = [
+        rng.integers(0, vocab, size=int(p)).astype(np.int32)
+        for p in lengths
+    ]
+    short_idx = [i for i, p in enumerate(lengths) if p == short_len]
+
+    def run_config(prefill_chunk, async_host) -> dict:
+        engine = ServeEngine(
+            graph, variables, slots=slots, cache_len=cache_len,
+            max_queue=len(prompts), decode_block=16 if full else 4,
+            prefill_chunk=prefill_chunk, async_host=async_host,
+        )
+
+        def drive(paced: bool) -> tuple[dict, list]:
+            results = {}
+            sub = []
+            tick = 0
+            while len(sub) < len(prompts) or engine.busy:
+                if not paced:
+                    while len(sub) < len(prompts):
+                        sub.append(engine.submit(
+                            prompts[len(sub)], max_new_tokens=max_new
+                        ))
+                elif tick % group_gap == 0 and len(sub) < len(prompts):
+                    for _ in range(4):  # one group: long + 3 shorts
+                        sub.append(engine.submit(
+                            prompts[len(sub)], max_new_tokens=max_new
+                        ))
+                for res in engine.step():
+                    results[res.id] = res
+                tick += 1
+            return results, sub
+
+        drive(False)  # warm-up: compiles the ladder + chunk programs
+        m = engine.metrics
+        # throughput + idle come from SATURATED drives (all requests
+        # queued upfront, engine never starved): wall time there
+        # measures capacity. The paced drives below measure latency —
+        # their wall time is mostly the arrival schedule, so a
+        # tokens/sec read off them would compare pacing, not engines
+        best = None
+        for _ in range(3):
+            # per-run deltas: the warm-up's compile-skewed sync waits
+            # must not leak into the measured figures
+            w0 = m.host_sync_wait_s
+            s0, g0 = sum(m.tick_seconds), m.tokens_generated
+            t0 = time.perf_counter()
+            drive(False)
+            secs = time.perf_counter() - t0
+            run = {
+                "secs": secs,
+                "tps": (m.tokens_generated - g0) / secs,
+                "idle": (
+                    min(1.0, (m.host_sync_wait_s - w0)
+                        / max(1e-9, sum(m.tick_seconds) - s0))
+                ),
+            }
+            if best is None or run["secs"] < best["secs"]:
+                best = run
+        # TTFT samples POOL across the paced runs: the embedded gate
+        # divides medians of ~3x the per-run sample count, so one GC
+        # pause or scheduler hiccup in one run cannot flip the build
+        all_ttft: list = []
+        all_short: list = []
+        for _ in range(3):
+            n0 = len(m.ttft_s)
+            results, sub = drive(True)
+            shorts = {sub[i] for i in short_idx}
+            # first tokens ARRIVE out of submit order under chunked
+            # fills — slice per class by request id, not position
+            all_ttft.extend(t * 1e3 for t in m.ttft_s[n0:])
+            all_short.extend(
+                t * 1e3
+                for rid, t in zip(m.ttft_req_ids[n0:], m.ttft_s[n0:])
+                if rid in shorts
+            )
+        # parity streams from the last paced drive: ids are assigned in
+        # submit order, so sub[i] is prompts[i]'s request
+        ttft = np.asarray(all_ttft, dtype=np.float64)
+        short_ttft = np.asarray(all_short, dtype=np.float64)
+        return {
+            "streams": tuple(
+                tuple(int(t) for t in results[i].tokens) for i in sub
+            ),
+            "tokens_per_sec": round(best["tps"], 1),
+            "ttft_ms_p99": round(float(np.percentile(ttft, 99)), 2),
+            "ttft_short_p99_ms": round(
+                float(np.percentile(short_ttft, 99)), 2
+            ),
+            "ttft_short_p50_ms": round(
+                float(np.percentile(short_ttft, 50)), 2
+            ),
+            "host_idle_fraction": round(best["idle"], 4),
+            "prefill_compiles": engine.prefill_compile_count,
+            "chunk_bucket_count": engine.num_chunk_buckets,
+            "chunked_prefills": m.chunked_prefills_total,
+            "overlapped_dispatches": m.overlapped_dispatches_total,
+        }
+
+    configs = {
+        "monolithic_sync": run_config(None, False),
+        "chunked_sync": run_config(chunk, False),
+        "monolithic_async": run_config(None, True),
+        "chunked_async": run_config(chunk, True),
+    }
+    base = configs["monolithic_sync"]
+    mismatches = sum(
+        cfg["streams"] != base["streams"] for cfg in configs.values()
+    )
+    out: dict = {}
+    for name, cfg in configs.items():
+        row = dict(cfg)
+        del row["streams"]
+        out[name] = {
+            f"{k}_{name}" if k == "tokens_per_sec" else k: v
+            for k, v in row.items()
+        }
+    # embedded budgets (tools/bench_regression.py): lower-is-better,
+    # measured > budget is a red build with no history needed.
+    #
+    # The three TIMING ratios are budgeted only at full scale: a smoke
+    # drive moves so little real compute that the ratios are pure
+    # host-scheduler noise (observed 0.0–66% tps "drop" and 0.4–1.9×
+    # idle "growth" across back-to-back identical CPU runs — the same
+    # heavy-tail argument that keeps latency out of bench_regression's
+    # history band). At smoke the values still ride along unbudgeted;
+    # the LOGICAL invariants (bit-identical streams, compile pins) are
+    # deterministic and gate everywhere.
+    out.update(
+        # short-request TTFT must not regress under chunking. The gate
+        # divides MEDIANS over samples pooled across runs — a max-like
+        # p99 of a dozen samples is one scheduler hiccup away from any
+        # value; the p99 figures per config ride along unbudgeted for
+        # the full-scale TPU record, where the long-fill blocking they
+        # expose is real compute, not dispatch overhead
+        ttft_short_p50_ratio=round(
+            configs["chunked_sync"]["ttft_short_p50_ms"]
+            / max(1e-9, base["ttft_short_p50_ms"]), 3
+        ),
+        tps_drop_pct=round(
+            max(
+                0.0,
+                (1.0 - configs["chunked_async"]["tokens_per_sec"]
+                 / max(1e-9, base["tokens_per_sec"])) * 100.0,
+            ), 2
+        ),
+        host_idle_ratio=round(
+            configs["monolithic_async"]["host_idle_fraction"]
+            / max(1e-9, base["host_idle_fraction"]), 3
+        ),
+        stream_mismatches=mismatches,
+        stream_mismatches_budget=0,
+        # chunked configs must stay inside the watchdog's program
+        # family: one compiled prefill program per chunk bucket, max
+        prefill_compile_excess=max(
+            configs[name]["prefill_compiles"]
+            - configs[name]["chunk_bucket_count"]
+            for name in ("chunked_sync", "chunked_async")
+        ),
+        prefill_compile_excess_budget=0,
+    )
+    if full:
+        out.update(
+            ttft_short_p50_ratio_budget=1.0,
+            tps_drop_pct_budget=20.0,
+            host_idle_ratio_budget=1.1,
+        )
+    out["model"] = {
+        "vocab": vocab, "d_model": d_model, "heads": heads,
+        "depth": depth, "slots": slots, "cache_len": cache_len,
+        "prefill_chunk": chunk, "max_new": max_new,
+        "long_len": long_len, "short_len": short_len,
+        "requests": len(prompts),
+    }
+    out["timing"] = (
+        "per config: warm-up, then best-of-3 SATURATED drives for "
+        "tokens/sec + host_idle_fraction, then 3 PACED drives (one "
+        "long + 3 shorts every "
+        f"{group_gap} ticks, slots={slots} so the queue never "
+        "saturates) pooling TTFT samples; all figures are per-run "
+        "deltas, never warm-up-skewed"
+    )
+    return {"serve_chunked": out}
 
 
 def bench_serve_paged(jax) -> dict:
@@ -2351,6 +2599,7 @@ def run(attempt: int) -> dict:
         "decode": lambda: bench_decode(jax, jnp),
         "serve": lambda: bench_serve(jax),
         "serve_faults": lambda: bench_serve_faults(jax),
+        "serve_chunked": lambda: bench_serve_chunked(jax),
         "serve_paged": lambda: bench_serve_paged(jax),
         "serve_int8": lambda: bench_serve_int8(jax),
         "serve_supervisor": lambda: bench_serve_supervisor(jax),

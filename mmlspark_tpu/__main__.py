@@ -96,6 +96,8 @@ def cmd_serve(args) -> int:
         autoscale=args.autoscale or None,
         models=args.models or None,
         device_budget=args.device_budget,
+        prefill_chunk=args.prefill_chunk,
+        async_host=args.async_host,
         metrics_port=args.metrics_port,
     )
     print(json.dumps(metrics, default=str))
@@ -333,6 +335,25 @@ def main(argv: list[str] | None = None) -> int:
         "with --mesh the quantized params replicate instead of "
         "tensor-parallel sharding (docs/PERFORMANCE.md 'Quantized "
         "decode')",
+    )
+    sp.add_argument(
+        "--prefill-chunk", type=int, default=None, metavar="N",
+        help="split every prefill into fixed N-token chunks (power of "
+        "two >= 8) interleaved with decode ticks: a long prompt no "
+        "longer stalls the whole batch for its full fill, and the "
+        "prefill compile ceiling drops to the chunk ladder's bucket "
+        "count; token streams stay bit-identical to monolithic "
+        "prefill (docs/PERFORMANCE.md 'Chunked prefill & async host "
+        "loop')",
+    )
+    sp.add_argument(
+        "--async-host", action="store_true",
+        help="pipelined host loop: dispatch decode block N+1 behind "
+        "block N's in-flight execution and fetch N's tokens only "
+        "after N+1 is enqueued — host scheduling work overlaps into "
+        "device time (watch host_idle_fraction drop); still at most "
+        "one host sync per block, and token streams stay "
+        "bit-identical to the synchronous loop (docs/PERFORMANCE.md)",
     )
     sp.add_argument(
         "--replicas", type=int, default=1, metavar="N",

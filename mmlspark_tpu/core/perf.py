@@ -229,6 +229,10 @@ class _FamilyStats:
     cost: ProgramCost
     dispatches: int = 0
     device_s: float = 0.0
+    #: issue-to-start time under a pipelined host loop: the span a
+    #: dispatch spent QUEUED behind the previous block's in-flight
+    #: execution — excluded from device_s so MFU/BW stay honest
+    queued_s: float = 0.0
     tokens: int = 0
 
 
@@ -287,9 +291,15 @@ class PerfAnalytics:
             self.register_program(family, analyze())
 
     def record_dispatch(self, family: str, seconds: float,
-                        tokens: int = 0) -> None:
+                        tokens: int = 0, queued_s: float = 0.0) -> None:
         """One dispatched execution of ``family`` that took ``seconds``
-        measured at the block's EXISTING sync point."""
+        measured at the block's EXISTING sync point. ``queued_s`` is
+        the portion of that interval the dispatch spent queued behind a
+        still-executing previous block (the async host loop's
+        pipelining): it is real wall time but NOT device execution, so
+        it is excluded from the device_s the MFU/BW denominators use —
+        without the split, a perfectly pipelined engine would halve its
+        apparent MFU while doing exactly the same math."""
         if not self.enabled:
             return
         st = self._families.get(family)
@@ -299,7 +309,9 @@ class PerfAnalytics:
             st = _FamilyStats(cost=ProgramCost.unavailable())
             self._families[family] = st
         st.dispatches += 1
-        st.device_s += seconds
+        queued_s = min(max(0.0, queued_s), max(0.0, seconds))
+        st.device_s += seconds - queued_s
+        st.queued_s += queued_s
         st.tokens += tokens
         if self._registry is not None:
             g = self._registry.gauge(f"perf.{family}.mfu")
@@ -396,6 +408,7 @@ class PerfAnalytics:
                 "cost_source": st.cost.source,
                 "dispatches": st.dispatches,
                 "device_s": round(st.device_s, 6),
+                "queued_s": round(st.queued_s, 6),
                 "tokens": st.tokens,
                 "mfu": _rnd(self._family_mfu(st), 6),
                 "hbm_bw_util_pct": _rnd(self._family_bw_pct(st), 4),

@@ -199,6 +199,20 @@ class SlotCachePool:
         # assignment deterministic for the parity tests
         self._free = list(range(slots - 1, -1, -1))
         self._leased: set[int] = set()
+        # deferred-free window (docs/SERVING.md "Async host loop"):
+        # while the engine has a decode block IN FLIGHT that was
+        # dispatched seeing this slot live, returning the slot to the
+        # free list immediately would let the next admission re-lease
+        # it and the in-flight block's masked writes would land in the
+        # NEW tenant's row. The engine brackets each in-flight window
+        # with defer_frees(gen)/flush_frees(gen): frees issued inside
+        # the window reset the device row state immediately (those
+        # updates are dependency-ordered AFTER the in-flight block's
+        # outputs) but the free-list return waits until the stamped
+        # generation's block has been fetched.
+        self._defer_gen: int | None = None
+        self._deferred: list[tuple[int, int]] = []
+        self._deferred_slots: set[int] = set()
         # DEVICE-resident per-slot decode state, donated through the
         # engine's fused decode-block program alongside the K/V buffers
         # (docs/SERVING.md "Decode blocks"): each slot's next write
@@ -263,14 +277,42 @@ class SlotCachePool:
         self._leased.add(slot)
         return slot
 
+    def defer_frees(self, gen: int) -> None:
+        """Open (or advance) a deferred-free window: until
+        :meth:`flush_frees` passes ``gen``, freed slots reset their
+        device row state immediately but stay OFF the free list — no
+        new lease can collide with a decode block dispatched before
+        the free (the async engine's zombie-row protection)."""
+        self._defer_gen = gen
+
+    def flush_frees(self, completed_gen: int | None = None) -> None:
+        """Return every deferred slot whose stamped dispatch generation
+        is ``<= completed_gen`` (all of them when None) to the free
+        list, and close the window when None."""
+        if completed_gen is None:
+            self._defer_gen = None
+        keep = []
+        for gen, slot in self._deferred:
+            if completed_gen is None or gen <= completed_gen:
+                self._deferred_slots.discard(slot)
+                self._leased.discard(slot)
+                self._free.append(slot)
+            else:
+                keep.append((gen, slot))
+        self._deferred = keep
+
     def free(self, slot: int) -> None:
-        if slot not in self._leased:
+        if slot not in self._leased or slot in self._deferred_slots:
             raise FriendlyError(
                 f"slot {slot} is not leased (double free, or never "
                 f"leased from this pool of {self.num_slots})"
             )
-        self._leased.remove(slot)
-        self._free.append(slot)
+        if self._defer_gen is not None:
+            self._deferred.append((self._defer_gen, slot))
+            self._deferred_slots.add(slot)
+        else:
+            self._leased.remove(slot)
+            self._free.append(slot)
         # restore the free-slot convention (pos 0, dead) so the fused
         # decode block keeps every write of this row inside the leased
         # region and its flash-decode length reads as zero
@@ -309,10 +351,13 @@ class SlotCachePool:
     # -- data path ---------------------------------------------------------
 
     def write_prefill(self, slot: int, prefill_cache: dict,
-                      length: int) -> None:
-        """Copy a batch-1 prefill cache (buffers of exactly ``length``
-        positions, from ``init_cache(graph, variables, 1, P)``) into
-        positions ``[0, length)`` of the slot's row."""
+                      length: int, start: int = 0) -> None:
+        """Copy a batch-1 prefill cache (buffers holding valid K/V for
+        positions ``[0, length)``) into positions ``[start, length)``
+        of the slot's row — ``start=0`` is the classic full prefill;
+        ``start>0`` resumes a partial fill whose prefix ``[0, start)``
+        the slot already holds (same contract as the paged pool's
+        ``write_prefill``, which prefix-cache resume uses)."""
         if slot not in self._leased:
             raise FriendlyError(f"slot {slot} is not leased")
         if length > self.cache_len:
@@ -320,7 +365,22 @@ class SlotCachePool:
                 f"prefill length {length} exceeds the pool's cache_len "
                 f"{self.cache_len}"
             )
+        if not 0 <= start < max(length, 1):
+            raise FriendlyError(
+                f"write_prefill start {start} must lie in [0, length "
+                f"{length})"
+            )
         quantized = self.kv_dtype == "int8"
+        if quantized and start:
+            # a lease's int8 scales are FIXED from its whole-prompt
+            # amax before the first decode dispatch; a partial write
+            # cannot re-derive them without dequantizing the resident
+            # prefix, so the dense pool requires full writes
+            raise FriendlyError(
+                "dense int8 pools require start=0 writes: quantization "
+                "scales are fixed per lease from the whole prompt "
+                "(use the paged pool for resumable int8 fills)"
+            )
         new_buffers = {}
         for name, entry in self.buffers.items():
             ck, cv = prefill_cache[name]
@@ -340,11 +400,11 @@ class SlotCachePool:
                 )
             else:
                 pk, pv = entry
-                nk = pk.at[slot, :length].set(
-                    ck[0, :length].astype(pk.dtype)
+                nk = pk.at[slot, start:length].set(
+                    ck[0, start:length].astype(pk.dtype)
                 )
-                nv = pv.at[slot, :length].set(
-                    cv[0, :length].astype(pv.dtype)
+                nv = pv.at[slot, start:length].set(
+                    cv[0, start:length].astype(pv.dtype)
                 )
                 new_buffers[name] = (nk, nv)
         if self._kv_shardings is not None:

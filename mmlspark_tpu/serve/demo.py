@@ -56,6 +56,8 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
              autoscale: str | None = None,
              models: str | None = None,
              device_budget: int | None = None,
+             prefill_chunk: int | None = None,
+             async_host: bool = False,
              metrics_port: int | None = None) -> dict:
     """Run the synthetic-traffic loop; returns the metrics dict the CLI
     prints as its one JSON line. With ``replicas > 1`` the loop drives
@@ -85,6 +87,7 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
             device_budget=device_budget,
             injector=parse_fault_spec(faults) if faults else None,
             telemetry_dir=telemetry_dir, trace_out=trace_out,
+            prefill_chunk=prefill_chunk, async_host=async_host,
             metrics_port=metrics_port,
         )
 
@@ -112,6 +115,11 @@ def run_demo(*, slots: int = 4, n_requests: int = 8,
         # --kv-dtype int8 / --quantize-weights -> the quantized decode
         # hot path (docs/PERFORMANCE.md "Quantized decode")
         kv_dtype=kv_dtype, quantize_weights=quantize_weights,
+        # --prefill-chunk N / --async-host -> chunked prefill + the
+        # pipelined host loop (docs/PERFORMANCE.md "Chunked prefill &
+        # async host loop"); threads through every engine mode —
+        # single, --replicas, --disagg — via these shared kwargs
+        prefill_chunk=prefill_chunk, async_host=async_host,
         # None = the engine's fused decode-block default (32)
         **({} if decode_block is None else {"decode_block": decode_block}),
     )
@@ -260,6 +268,8 @@ def _run_multimodel_demo(spec: str, *, n_requests: int,
                          seed: int, device_budget: int | None,
                          injector, telemetry_dir: str | None,
                          trace_out: str | None,
+                         prefill_chunk: int | None = None,
+                         async_host: bool = False,
                          metrics_port: int | None = None) -> dict:
     """The ``--models`` body: spec -> MultiModelEngine, then a
     deterministic interleaved arrival schedule — ``n_requests`` per
@@ -271,8 +281,14 @@ def _run_multimodel_demo(spec: str, *, n_requests: int,
     from mmlspark_tpu.serve.engine import ServeEngine
     from mmlspark_tpu.serve.multimodel import engine_from_spec
 
+    lm_kwargs = {}
+    if prefill_chunk is not None:
+        lm_kwargs["prefill_chunk"] = prefill_chunk
+    if async_host:
+        lm_kwargs["async_host"] = True
     engine = engine_from_spec(
         spec, device_budget=device_budget, faults=injector, seed=seed,
+        lm_kwargs=lm_kwargs,
     )
     rng = np.random.default_rng(seed)
     streams: dict[str, list] = {}

@@ -127,6 +127,8 @@ class ServeEngine:
                  kv_dtype: str = "bf16",
                  quantize_weights: bool = False,
                  role: str = "both",
+                 prefill_chunk: int | None = None,
+                 async_host: bool = False,
                  registry=None):
         if not graph.extra.get("causal", False):
             raise FriendlyError(
@@ -166,6 +168,59 @@ class ServeEngine:
                 "(1 = per-token dispatch, larger fuses T micro-steps "
                 "into one device program)"
             )
+        # chunked prefill (docs/SERVING.md "Chunked prefill"): cap the
+        # widest prefill dispatch at ``prefill_chunk`` tokens — a long
+        # prompt's fill becomes a sequence of bounded chunk dispatches
+        # interleaved with decode ticks, so one joiner can never
+        # head-of-line-block every co-resident stream. Chunk widths
+        # live on the SAME power-of-two ladder as prefill buckets
+        # ({8, 16, ..., prefill_chunk}), so the compile pin tightens to
+        # ``prefill_compile_count <= num_chunk_buckets``.
+        if prefill_chunk is not None:
+            if (
+                prefill_chunk < 8
+                or prefill_chunk & (prefill_chunk - 1)
+            ):
+                raise FriendlyError(
+                    f"prefill_chunk must be a power of two >= 8 (the "
+                    f"prefill bucket ladder's floor), got {prefill_chunk}"
+                )
+            if prefill_chunk > cache_len:
+                raise FriendlyError(
+                    f"prefill_chunk ({prefill_chunk}) exceeds cache_len "
+                    f"({cache_len}); a chunk wider than the KV buffers "
+                    "can never be dispatched — drop the flag or shrink "
+                    "the chunk"
+                )
+            if graph.extra.get("n_experts"):
+                raise FriendlyError(
+                    f"'{graph.name}' is a MoE model, which prefills at "
+                    "exact length (expert-capacity routing is not "
+                    "causal, so padded chunk windows could change real "
+                    "tokens' expert assignment); chunked prefill "
+                    "requires bucketed prefill — drop prefill_chunk"
+                )
+        self._prefill_chunk = prefill_chunk
+        # pipelined async host loop (docs/SERVING.md "Async host
+        # loop"): dispatch block N+1 behind block N's in-flight
+        # execution and only then fetch N's tokens, so host work
+        # (scheduling, SLO eval, telemetry, fault hooks) overlaps into
+        # device time. Token streams stay bit-identical — pipelining
+        # reorders HOST work, never device programs' inputs (see
+        # _decode_phase_async for the identity-fence and deferred-free
+        # machinery that guarantees it).
+        self._async_host = bool(async_host)
+        #: in-flight decode block record (async mode): set at dispatch,
+        #: consumed by the NEXT tick's fetch
+        self._inflight: dict | None = None
+        #: monotone dispatch generation stamping the pools' deferred
+        #: frees — a freed slot returns to the free list only after the
+        #: block that saw it live has been fetched
+        self._dispatch_gen = 0
+        #: when the previously fetched block's outputs materialized —
+        #: the queued-vs-executing attribution anchor for the next
+        #: pipelined dispatch interval (core/perf.py record_dispatch)
+        self._prev_block_done = 0.0
         self.graph = graph
         self.pad_id = pad_id
         self.cache_len = cache_len
@@ -305,6 +360,8 @@ class ServeEngine:
                 self.pool.device_bytes_per_device()
             ),
             kv_dtype=kv_dtype,
+            prefill_chunk=prefill_chunk or 0,
+            async_host=self._async_host,
             namespace=(
                 f"replica{replica}." if replica is not None else ""
             ),
@@ -439,6 +496,31 @@ class ServeEngine:
                 registry=self.metrics.registry, recorder=self.recorder,
                 expected_programs=self.num_prefill_buckets,
             )
+
+        # the chunked-fill program IS the resume body: one forward over
+        # a chunk window of the sequence against the fill's carry cache
+        # (a full-cache_len linear cache), keyed by the chunk BUCKET
+        # alone — ``pos``/``last`` are traced and the carry's shape is
+        # fixed, so at most ``num_chunk_buckets`` programs ever compile
+        # unlike resume (one shot, output handed straight to
+        # write_prefill), the chunk program's output cache RE-ENTERS the
+        # next chunk call as the carry — under a mesh the outputs are
+        # pinned replicated so the signature reaches its fixed point on
+        # the first call instead of retracing on GSPMD's own choice
+        chunk_kwargs = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            chunk_kwargs["out_shardings"] = NamedSharding(
+                self.mesh, PartitionSpec()
+            )
+        self._chunk = None
+        if self._prefill_chunk is not None:
+            self._chunk = RetraceWatchdog(
+                ProgramCountingJit(jax.jit(_resume, **chunk_kwargs)),
+                "serve.chunk",
+                registry=self.metrics.registry, recorder=self.recorder,
+                expected_programs=self.num_chunk_buckets,
+            )
         # the FUSED decode block (models.generate.make_decode_block):
         # lax.scan over t greedy micro-steps with the scan length
         # static (one program per ladder size) and the whole device
@@ -496,10 +578,36 @@ class ServeEngine:
             bucket *= 2
         return min(bucket, self.cache_len)
 
+    def chunk_bucket(self, n: int) -> int:
+        """Padded width the chunked-fill program runs at for a chunk of
+        ``n`` real tokens: the next power of two >= max(n, 8), capped at
+        ``prefill_chunk``. Intermediate chunks are exactly
+        ``prefill_chunk`` wide (the top bucket); only a fill's FINAL
+        chunk can land on a smaller rung."""
+        bucket = 8
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, self._prefill_chunk)
+
+    @property
+    def num_chunk_buckets(self) -> int:
+        """How many distinct chunked-fill programs CAN exist — one per
+        ladder width in {8, 16, ..., prefill_chunk}; 0 with chunking
+        off."""
+        if self._prefill_chunk is None:
+            return 0
+        return self._prefill_chunk.bit_length() - 3
+
     @property
     def num_prefill_buckets(self) -> int:
         """How many distinct prefill programs CAN exist for this engine
-        — the ceiling the compile-guard tests pin prefill to."""
+        — the ceiling the compile-guard tests pin prefill to. With
+        chunked prefill the monolithic program never runs and the
+        ceiling is the CHUNK ladder's (``num_chunk_buckets`` <= the
+        monolithic count, since the chunk cap truncates the bucket
+        ladder)."""
+        if self._prefill_chunk is not None:
+            return self.num_chunk_buckets
         return len({
             self.prefill_bucket(p) for p in range(1, self.cache_len)
         })
@@ -674,7 +782,12 @@ class ServeEngine:
     def prefill_compile_count(self) -> int:
         """How many prefill programs have compiled — bounded by
         ``num_prefill_buckets`` for the life of the engine (asserted in
-        tests), however many distinct prompt lengths arrive."""
+        tests), however many distinct prompt lengths arrive. With
+        chunked prefill every fill runs through the chunk program, so
+        the count (and its ``num_chunk_buckets`` ceiling) is the chunk
+        ladder's."""
+        if self._prefill_chunk is not None:
+            return jit_cache_size(self._chunk)
         return jit_cache_size(self._prefill)
 
     @property
@@ -934,6 +1047,16 @@ class ServeEngine:
                         self.recorder.record(
                             "handoff_fallback", tick=tick, id=req.id,
                         )
+                if not adopted and self._prefill_chunk is not None:
+                    # chunked prefill: admission only STARTS the fill
+                    # (prefix probe + carry allocation — no forward
+                    # pass); _advance_fills below dispatches bounded
+                    # chunk windows, one per tick per fill, so a long
+                    # prompt can never monopolize a tick. A fill no
+                    # wider than one chunk still completes on its
+                    # admission tick — short-prompt TTFT is unchanged.
+                    self._start_fill(req, slot, seq, tick)
+                    continue
                 # prefix-cache probe: a hit swaps the full-prompt
                 # prefill for a REMAINDER resume against the cached
                 # prefix's pages (shared, refcounted — the prefix
@@ -1177,12 +1300,17 @@ class ServeEngine:
                 if done is not None:
                     finished.append(done)
 
+        if self._sched.filling:
+            tokens_this_tick += self._advance_fills(tick, finished)
+
         # slot occupancy AS OF the decode dispatch: with fused blocks a
         # request can join and retire inside one tick, so sampling after
         # retirement would report empty slots that were busy all block
         leased_this_tick = self.pool.leased_count
 
-        if self._sched.active:
+        if self._async_host:
+            tokens_this_tick += self._decode_phase_async(tick, finished)
+        elif self._sched.active:
             tokens_this_tick += self._decode_phase(tick, finished)
 
         self._sched.tick_count += 1
@@ -1218,6 +1346,595 @@ class ServeEngine:
         ):
             self.checkpoint()
         return finished
+
+    # -- chunked prefill (docs/SERVING.md "Chunked prefill") ---------------
+
+    def _fresh_carry(self) -> dict:
+        """A zeroed batch-1 linear cache spanning the FULL cache_len —
+        the chunked fill's carry: every chunk program reads and extends
+        it, and its fixed shape keeps chunk programs keyed by the chunk
+        bucket alone. Committed REPLICATED under a mesh (mirroring
+        ``gather_prefix``) so the chunk jit sees one signature per
+        bucket."""
+        cache = init_cache(self.graph, self.variables, 1, self.cache_len)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            cache = jax.device_put(
+                cache, NamedSharding(self.mesh, PartitionSpec())
+            )
+        return cache
+
+    def _start_fill(self, req, slot: int, seq, tick: int) -> None:
+        """Begin a chunked fill in a freshly leased slot: probe the
+        prefix cache (a hit seeds the carry with the shared prefix,
+        gathered once) and register the fill frontier with the
+        scheduler. No forward pass runs here — ``_advance_fills`` owns
+        every chunk dispatch."""
+        total = len(seq)
+        keep = 0
+        entry = None
+        hit = (
+            self.pool.prefix_lookup(seq, self.chunk_bucket, slot=slot)
+            if self._prefix_cache else None
+        )
+        if hit is not None:
+            entry, keep = hit
+            carry = self.pool.gather_prefix(entry, keep)
+        else:
+            carry = self._fresh_carry()
+        self._sched.start_fill(
+            slot, req, total, keep, {"cache": carry, "entry": entry},
+            tick,
+        )
+        span = self._spans.get(req.id)
+        if span is not None:
+            span.event("fill_started", tick=tick, total=total,
+                       reused=keep)
+
+    def _advance_fills(self, tick: int, finished: list) -> int:
+        """Advance every mid-fill slot by ONE bounded chunk dispatch.
+        Intermediate chunks are exactly ``prefill_chunk`` wide and
+        chain asynchronously (no host sync — the next chunk's inputs
+        are the previous chunk's in-flight outputs); a fill's FINAL
+        chunk pads to its ladder bucket, lands the carry in the slot
+        via ``write_prefill(start=keep)`` and pays the fill's one host
+        sync for the first token. Bit-identical to monolithic prefill:
+        the chunks recompute the same K/V at the same positions from
+        the same tokens, and the final logits slice reads the true
+        last-token position. Returns the first tokens emitted by fills
+        that completed this tick."""
+        tokens = 0
+        for slot in sorted(self._sched.filling):
+            fs = self._sched.filling[slot]
+            req = fs.req
+            seq = (
+                np.concatenate([req.prompt, req.prefix])
+                if len(req.prefix) else req.prompt
+            )
+            r = fs.total - fs.filled
+            final = r <= self._prefill_chunk
+            if final:
+                bucket = self.chunk_bucket(r)
+                # final-chunk WINDOW TRICK: the padded bucket window
+                # must not overflow cache_len (a clamped
+                # dynamic_update_slice would corrupt earlier carry
+                # positions), so slide its start down and RECOMPUTE the
+                # overlap [start, filled) — same tokens at the same
+                # positions against the same carry prefix produce
+                # identical K/V, so the overwrite is a no-op by value
+                # and the program width stays on the ladder
+                start = min(fs.filled, self.cache_len - bucket)
+                width = bucket
+                padded = np.full((bucket,), self.pad_id, np.int32)
+                padded[: fs.total - start] = seq[start:fs.total]
+                last = (fs.total - 1) - start
+            else:
+                start = fs.filled
+                width = self._prefill_chunk
+                padded = np.ascontiguousarray(
+                    seq[start:start + width], dtype=np.int32
+                )
+                last = width - 1
+            family = f"chunk[{width}]"
+            if self.metrics.perf.wants_program(family):
+                self.metrics.perf.register_program(
+                    family,
+                    analyze_jit_cost(
+                        self._chunk._fn._fn, self.variables,
+                        padded[None], fs.carry["cache"], start, last,
+                    ),
+                )
+            attempts = 0
+            tp = time.perf_counter()
+            if not final:
+                ok = False
+                with annotate("serve.prefill"):
+                    while True:
+                        try:
+                            if self._faults is not None:
+                                self._faults.fire(
+                                    "serve.prefill", tick=tick,
+                                    request=req.id,
+                                    replica=self._replica,
+                                )
+                            _tok_d, cache = self._chunk(
+                                self.variables,
+                                jnp.asarray(padded[None]),
+                                fs.carry["cache"], start, last,
+                            )
+                            # the chunk program is NOT donated: the old
+                            # carry survives until this rebind, so a
+                            # faulted dispatch retries on intact state
+                            fs.carry["cache"] = cache
+                            ok = True
+                            break
+                        except Exception as e:
+                            if is_resource_exhausted(e):
+                                self._note_oom(tick, "serve.prefill")
+                            elif not is_transient(e):
+                                raise
+                            attempts += 1
+                            if attempts > self._retry_limit:
+                                break
+                            self._backoff(attempts)
+                if not ok:
+                    self._sched.fill_done(slot)
+                    finished.append(self._quarantine_unactivated(
+                        req, slot, tick, "prefill_failed"
+                    ))
+                    continue
+                fs.filled += width
+                chunk_s = time.perf_counter() - tp
+                self.metrics.record_prefill_chunk()
+                # no host sync here — the measured interval is
+                # enqueue-side only; device-time attribution rides the
+                # final chunk's sync
+                self.metrics.perf.record_dispatch(family, chunk_s)
+                self.recorder.record(
+                    "prefill_chunk", tick=tick, id=req.id,
+                    filled=fs.filled, total=fs.total,
+                    ms=round(chunk_s * 1e3, 3),
+                )
+                span = self._spans.get(req.id)
+                if span is not None:
+                    span.event("prefill_chunk", tick=tick,
+                               filled=fs.filled, total=fs.total)
+                continue
+
+            # -- final chunk: compute, land in the slot, sync ----------
+            entry = fs.carry.get("entry")
+            first = None
+            stale = False
+            with annotate("serve.prefill"):
+                while True:
+                    try:
+                        if self._faults is not None:
+                            self._faults.fire(
+                                "serve.prefill", tick=tick,
+                                request=req.id, replica=self._replica,
+                            )
+                        first_d, cache = self._chunk(
+                            self.variables, jnp.asarray(padded[None]),
+                            fs.carry["cache"], start, last,
+                        )
+                        # map the shared prefix pages FIRST (as the
+                        # monolithic resume path does), then scatter
+                        # only [keep, total)
+                        if entry is not None and not self.pool.map_prefix(
+                            slot, entry, fs.keep
+                        ):
+                            stale = True
+                            break
+                        self.pool.write_prefill(
+                            slot, cache, fs.total, start=fs.keep
+                        )
+                        fs.carry["cache"] = cache
+                        first = int(first_d[0])
+                        break
+                    except Exception as e:
+                        if is_resource_exhausted(e):
+                            self._note_oom(tick, "serve.prefill")
+                        elif not is_transient(e):
+                            raise
+                        attempts += 1
+                        if attempts > self._retry_limit:
+                            break
+                        self._backoff(attempts)
+            if stale:
+                # the prefix entry evicted since the fill started: the
+                # slot can no longer map pages for [0, keep), so the
+                # fill restarts from scratch — the chunked analog of
+                # the monolithic stale-hit full-prefill fallback, and
+                # equally deterministic (the eventual stream is
+                # unchanged)
+                fs.filled = 0
+                fs.keep = 0
+                fs.carry = {"cache": self._fresh_carry(), "entry": None}
+                continue
+            if first is None:
+                self._sched.fill_done(slot)
+                finished.append(self._quarantine_unactivated(
+                    req, slot, tick, "prefill_failed"
+                ))
+                continue
+            fs.filled = fs.total
+            chunk_s = time.perf_counter() - tp
+            self.metrics.record_prefill_chunk()
+            if self._faults is not None:
+                poison = self._faults.poison_value(
+                    "serve.prefill", tick=tick, request=req.id,
+                    replica=self._replica,
+                )
+                if poison is not None:
+                    first = int(poison)
+            if self._prefix_cache and entry is None:
+                self.pool.prefix_insert(slot, seq)
+            self._sched.fill_done(slot)
+            span = self._spans.get(req.id)
+            if span is not None:
+                span.event(
+                    "prefill", tick=tick, bucket=bucket,
+                    ms=round(chunk_s * 1e3, 3), reused=fs.keep,
+                )
+            self.metrics.perf.record_dispatch(family, chunk_s, tokens=1)
+            self.recorder.record(
+                "dispatch", tick=tick, family=family,
+                ms=round(chunk_s * 1e3, 3), tokens=1,
+            )
+            if not self._token_ok(first):
+                finished.append(self._quarantine_unactivated(
+                    req, slot, tick, "poisoned_token"
+                ))
+                continue
+            self.metrics.record_first_token(req, tick, bucket=bucket)
+            tokens += 1
+            if self.role == "prefill" and not (
+                len(req.prefix) + 1 >= req.max_new_tokens
+                or (req.eos_id is not None and first == req.eos_id)
+            ):
+                # prefill-role hand-off fires at FILL COMPLETION: the
+                # carry's rows [0, total) are exactly the monolithic
+                # prefill output the payload contract expects
+                self.pool.free(slot)
+                payload = {
+                    "id": req.id,
+                    "prompt": np.asarray(req.prompt, np.int32),
+                    "prefix": np.asarray(req.prefix, np.int32),
+                    "length": fs.total,
+                    "first_token": int(first),
+                    "kv": fs.carry["cache"],
+                    "max_new_tokens": req.max_new_tokens,
+                    "eos_id": req.eos_id,
+                    "trace_id": req.trace_id,
+                }
+                payload["checksum"] = integrity.payload_checksum(
+                    payload
+                )
+                self._outbox.append(payload)
+                self.recorder.record(
+                    "handoff_out", tick=tick, id=req.id,
+                    seq_len=fs.total, trace=req.trace_id,
+                )
+                finished.append(
+                    self._sched.handoff_result(req, first, tick)
+                )
+                continue
+            done = self._sched.activate(slot, req, first, tick)
+            if done is not None:
+                finished.append(done)
+        return tokens
+
+    # -- pipelined async host loop (docs/SERVING.md "Async host loop") -----
+
+    def _decode_phase_async(self, tick: int, finished: list) -> int:
+        """One PIPELINED decode round: dispatch this tick's block N+1
+        behind the in-flight block N, then fetch N's tokens — the host
+        bookkeeping between the two (and the whole admit/fill phase
+        before them) overlaps into N's device execution. At most one
+        host sync per block, exactly as the synchronous loop, but the
+        sync lands one tick late and rarely blocks. Token streams are
+        bit-identical to the synchronous engine: dispatch inputs are
+        derived from device-side state (in-flight last tokens selected
+        on device) plus conservative host budget views, and the fetch's
+        identity fence drops any row whose slot changed hands after
+        dispatch."""
+        prev = self._inflight
+        self._inflight = None
+        status = self._dispatch_block(tick, prev)
+        n_tokens = self._fetch_inflight(prev, tick, finished)
+        if status == "failed":
+            # the batch stayed undispatchable through retries AND
+            # degradation — quarantine what is left of it, AFTER the
+            # previous block's tokens were committed above
+            for slot in list(self._sched.active):
+                finished.append(self._quarantine_slot(
+                    slot, tick, "decode_failed"
+                ))
+        if self._inflight is not None and not self._sched.busy:
+            # every request retired at the fetch above (e.g. EOS swept
+            # the batch) while a speculative block is still in flight:
+            # drain it now — its rows all fail the identity fence, so
+            # it contributes nothing, but run() must not exit with an
+            # open deferred-free window
+            inf, self._inflight = self._inflight, None
+            n_tokens += self._fetch_inflight(inf, tick, finished)
+        return n_tokens
+
+    def _dispatch_block(self, tick: int, prev: dict | None) -> str:
+        """Dispatch one fused decode block WITHOUT fetching it (async
+        mode). Returns ``"ok"`` (in-flight record stored), ``"idle"``
+        (nothing to dispatch: no active slots, or every active slot's
+        budget may already exhaust inside ``prev``) or ``"failed"``
+        (retries exhausted).
+
+        The pipelining contract, input by input:
+
+        * last tokens — the host's view lags for slots riding ``prev``,
+          so their rows select ``prev``'s final emitted token ON DEVICE
+          (``jnp.where`` over the in-flight output; async, no sync).
+        * remaining budgets — reduced by ``prev``'s block size for
+          in-flight slots (the conservative view). A slot whose
+          adjusted budget is <= 0 either retires at ``prev``'s fetch
+          (its rows here are dropped by the identity fence) or was
+          going to die on device anyway; the block-size clamp uses only
+          POSITIVE adjusted budgets, so no surviving stream can overrun
+          its budget mid-block — the same parity rule as the
+          synchronous loop.
+        * page frontiers — advanced by ``prev``'s block size before
+          ``ensure_decode_pages``, covering the writes the in-flight
+          block may still land.
+        """
+        attempts = 0
+        while self._sched.active:
+            states = dict(self._sched.active)
+            lag = {}
+            if prev is not None:
+                for slot, st in prev["states"].items():
+                    if states.get(slot) is st:
+                        lag[slot] = prev["t_block"]
+            pre_pos = {
+                slot: st.pos + lag.get(slot, 0)
+                for slot, st in states.items()
+            }
+            tok, rem, eos, _ = self._sched.decode_block_inputs(
+                self.pad_id
+            )
+            rems = []
+            for slot, st in states.items():
+                adj = (
+                    st.req.max_new_tokens - len(st.out)
+                    - lag.get(slot, 0)
+                )
+                rem[slot] = adj
+                if adj > 0:
+                    rems.append(adj)
+            if not rems:
+                return "idle"
+            t_block = self._block_size(min(rems))
+            slot_sh = None
+            if self.mesh is not None:
+                slot_sh = self.pool.slot_sharding
+                tok_d = jax.device_put(jnp.asarray(tok), slot_sh)
+                rem_d = jax.device_put(jnp.asarray(rem), slot_sh)
+                eos_d = jax.device_put(jnp.asarray(eos), slot_sh)
+            else:
+                tok_d, rem_d, eos_d = (
+                    jnp.asarray(tok), jnp.asarray(rem), jnp.asarray(eos)
+                )
+            if lag:
+                sel = np.zeros((self.pool.num_slots,), bool)
+                for slot in lag:
+                    sel[slot] = True
+                sel_d = jnp.asarray(sel)
+                tok_d = jnp.where(sel_d, prev["toks"][:, -1], tok_d)
+                if slot_sh is not None:
+                    # re-commit the selected vector so the jit sees the
+                    # pinned signature every tick
+                    tok_d = jax.device_put(tok_d, slot_sh)
+            family = f"decode[T={t_block}]"
+            if self.metrics.perf.wants_program(family):
+                self.metrics.perf.register_program(
+                    family,
+                    analyze_jit_cost(
+                        self._decode._fn._fn, self.variables,
+                        self.pool.buffers, self.pool.positions,
+                        self.pool.live, tok_d, rem_d, eos_d, t_block,
+                    ),
+                )
+            try:
+                with annotate("serve.decode"):
+                    issued = time.perf_counter()
+                    if self._paged:
+                        self.pool.ensure_decode_pages(pre_pos, t_block)
+                    if self._faults is not None:
+                        self._faults.fire("serve.decode", tick=tick,
+                                          replica=self._replica)
+                    # the live vector is DONATED into this dispatch,
+                    # but when it is also the in-flight block's fetch
+                    # target (prev's output) donation would delete it
+                    # before prev's device_get — donate a copy instead
+                    # (S bools; async, ordered after prev)
+                    live_in = self.pool.live
+                    if prev is not None:
+                        live_in = jnp.copy(live_in)
+                    toks, live, buffers, positions = self._decode(
+                        self.variables, self.pool.buffers,
+                        self.pool.positions, live_in,
+                        tok_d, rem_d, eos_d, t_block,
+                    )
+                    self.pool.buffers = buffers
+                    self.pool.positions = positions
+                    self.pool.live = live
+            except Exception as e:
+                if is_resource_exhausted(e):
+                    self._note_oom(tick, "serve.decode")
+                elif not is_transient(e):
+                    raise
+                attempts += 1
+                if attempts > self._retry_limit:
+                    return "failed"
+                self._backoff(attempts)
+                continue
+            self._dispatch_gen += 1
+            self.pool.defer_frees(self._dispatch_gen)
+            self._inflight = {
+                "toks": toks, "live": live, "states": states,
+                "pre_pos": pre_pos, "t_block": t_block,
+                "family": family, "issued": issued,
+                "gen": self._dispatch_gen, "tick": tick,
+                "n_active": len(states),
+                "overlapped": prev is not None,
+            }
+            if prev is not None:
+                self.metrics.record_overlapped_dispatch()
+            return "ok"
+        return "idle"
+
+    def _fetch_inflight(self, inflight: dict | None, tick: int,
+                        finished: list) -> int:
+        """Fetch and consume one previously dispatched block (async
+        mode): the block's ONE host sync, then the same poison/
+        validation/consume/accounting pipeline as the synchronous
+        loop — except every row passes the IDENTITY FENCE (the slot
+        must still hold the request captured at dispatch) and the
+        pools' deferred frees stamped up to this block's generation
+        flush afterwards."""
+        if inflight is None:
+            if self._inflight is None:
+                # nothing in flight in either direction: close the
+                # deferred-free window so frees turn immediate again
+                self.pool.flush_frees(None)
+            return 0
+        states = inflight["states"]
+        pre_pos = inflight["pre_pos"]
+        t_block = inflight["t_block"]
+        family = inflight["family"]
+        n_active = inflight["n_active"]
+
+        def _live_rows():
+            return [
+                s for s, st in states.items()
+                if self._sched.active.get(s) is st
+            ]
+
+        toks_h = live_h = None
+        fetch_attempts = 0
+        wait0 = time.perf_counter()
+        while True:
+            try:
+                if self._faults is not None:
+                    self._faults.fire("serve.device_get", tick=tick,
+                                      replica=self._replica)
+                toks_h, live_h = jax.device_get(
+                    (inflight["toks"], inflight["live"])
+                )
+                break
+            except Exception as e:
+                if not (is_transient(e) or is_resource_exhausted(e)):
+                    raise
+                fetch_attempts += 1
+                if fetch_attempts > self._retry_limit:
+                    break
+                self._backoff(fetch_attempts)
+        done = time.perf_counter()
+        self.metrics.record_host_sync(done - wait0)
+        prev_done = self._prev_block_done
+        self._prev_block_done = done
+        if toks_h is None:
+            for slot in _live_rows():
+                finished.append(self._quarantine_slot(
+                    slot, tick, "device_get_failed"
+                ))
+            self.pool.flush_frees(inflight["gen"])
+            if self._inflight is None:
+                self.pool.flush_frees(None)
+            return 0
+
+        # queued-vs-executing attribution: a pipelined block could not
+        # START before the previous block's outputs materialized (its
+        # inputs are that block's donated buffers), so the span from
+        # issue to the previous fetch's completion is queue time, not
+        # device time — core/perf.py subtracts it from device_s so MFU
+        # and bandwidth figures stay honest under pipelining
+        dispatch_s = done - inflight["issued"]
+        queued_s = 0.0
+        if inflight["overlapped"]:
+            queued_s = min(
+                dispatch_s, max(0.0, prev_done - inflight["issued"])
+            )
+        toks_h = np.asarray(toks_h)
+        if toks_h.ndim == 1:
+            toks_h = toks_h[:, None]
+        if self._faults is not None:
+            toks_h = self._faults.poison_block(
+                "serve.device_get", toks_h, tick=tick,
+                slots=_live_rows(), replica=self._replica,
+            )
+        bad_rows = (toks_h < 0).any(axis=1)
+        if self._vocab is not None:
+            bad_rows |= (toks_h >= int(self._vocab)).any(axis=1)
+        quarantined: set[int] = set()
+        if bad_rows.any():
+            for slot in _live_rows():
+                if bad_rows[slot]:
+                    finished.append(self._quarantine_slot(
+                        slot, tick, "poisoned_token"
+                    ))
+                    quarantined.add(slot)
+
+        blk_finished, consumed = self._sched.consume(
+            toks_h, tick, states=states
+        )
+        n_tokens = sum(consumed.values())
+        live_kv = sum(
+            c * (pre_pos[slot] + 1) + c * (c - 1) // 2
+            for slot, c in consumed.items()
+        )
+        exec_s = max(0.0, dispatch_s - queued_s)
+        self.metrics.record_decode(
+            n_active, exec_s, tokens_emitted=n_tokens,
+            block=t_block, live_kv=live_kv, cache_len=self.cache_len,
+        )
+        self.metrics.perf.record_dispatch(
+            family, dispatch_s, tokens=n_tokens, queued_s=queued_s,
+        )
+        self.recorder.record(
+            "dispatch", tick=tick, family=family,
+            ms=round(exec_s * 1e3, 3),
+            queued_ms=round(queued_s * 1e3, 3), tokens=n_tokens,
+        )
+        if __debug__:
+            # device/host parity holds row by row for every request
+            # that kept its slot from dispatch to fetch — rows the
+            # identity fence dropped (consume skipped them) and
+            # quarantined rows are exempt, mirroring the synchronous
+            # loop's quarantine exemption
+            for slot, st in states.items():
+                if slot in quarantined or consumed.get(slot) is None:
+                    continue
+                assert bool(live_h[slot]) == (
+                    self._sched.active.get(slot) is st
+                ), (
+                    f"device live mask and host retirement disagree "
+                    f"for slot {slot} (async block T={t_block})"
+                )
+        decode_ms = round(exec_s * 1e3, 3)
+        for slot, st in states.items():
+            if consumed.get(slot) is None:
+                continue
+            span = self._spans.get(st.req.id)
+            if span is not None:
+                span.event("decode", tick=tick, pos=pre_pos[slot],
+                           n_active=n_active, block=t_block,
+                           tokens=consumed.get(slot, 0),
+                           step_ms=decode_ms)
+        finished.extend(blk_finished)
+        self._note_clean_dispatch(tick)
+        self.pool.flush_frees(inflight["gen"])
+        if self._inflight is None:
+            self.pool.flush_frees(None)
+        return n_tokens
 
     def _decode_phase(self, tick: int, finished: list) -> int:
         """One fused decode BLOCK for all active slots, behind the
@@ -1322,6 +2039,7 @@ class ServeEngine:
             # decode past this block and skip its tokens
             toks_h = live_h = None
             fetch_attempts = 0
+            wait0 = time.perf_counter()
             while True:
                 try:
                     if self._faults is not None:
@@ -1339,6 +2057,9 @@ class ServeEngine:
                         break
                     self._backoff(fetch_attempts)
             decode_s = time.perf_counter() - td
+            # the sync loop pays its block's full device time here —
+            # the host-idle numerator the async loop exists to shrink
+            self.metrics.record_host_sync(time.perf_counter() - wait0)
             if toks_h is None:
                 # the block's tokens are unrecoverable on host: every
                 # active stream now has a gap — definite failure beats
@@ -1446,6 +2167,11 @@ class ServeEngine:
                 if self.tick - start >= max_ticks:
                     n_queued = self._sched.queue_depth
                     n_active = len(self._sched.active)
+                    # abandon any in-flight pipelined block and close
+                    # the deferred-free window so the stall's slot
+                    # frees land immediately
+                    self._inflight = None
+                    self.pool.flush_frees(None)
                     for res in self._sched.stall_pending(self.tick):
                         results[res.id] = res
                         self.metrics.record_finish(res)
@@ -1668,6 +2394,7 @@ class ServeEngine:
             "role": self.role,
             "queue_depth": self.queue_depth,
             "active": len(self._sched.active),
+            "filling": len(self._sched.filling),
             "degraded": self.degraded,
             "slo_burning": (
                 bool(self._slo.should_shed)
@@ -1700,6 +2427,11 @@ class ServeEngine:
         # undelivered hand-off payloads are unreachable on a dead
         # engine; the fleet re-routes those requests from its ledger
         self._outbox.clear()
+        # an in-flight pipelined block dies with the engine: drop the
+        # record and close the deferred-free window so every leased
+        # slot below releases immediately
+        self._inflight = None
+        self.pool.flush_frees(None)
         leased = self.pool.leased_slots()
         for slot in leased:
             self.pool.free(slot)
@@ -1782,6 +2514,22 @@ class ServeEngine:
                 "trace": req.trace_id,
             })
         queued = []
+        # mid-fill requests checkpoint as queued entries with their
+        # resume prefix: restore re-prefills from scratch, and since a
+        # chunked fill emits no tokens before completion there is no
+        # partial-fill state worth carrying — determinism does the rest
+        for _slot, fs in sorted(self._sched.filling.items()):
+            req = fs.req
+            queued.append({
+                "id": req.id,
+                "prompt": [int(x) for x in req.prompt],
+                "emitted": [int(x) for x in req.prefix],
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "deadline_tick": req.deadline_tick,
+                "submit_tick": req.submit_tick,
+                "trace": req.trace_id,
+            })
         for req in self._sched.queue:
             queued.append({
                 "id": req.id,

@@ -1286,6 +1286,11 @@ class DisaggFleet:
                 "prefill_compile_count": (
                     rep.engine.prefill_compile_count
                 ),
+                "chunked_prefills_total": d["chunked_prefills_total"],
+                "overlapped_dispatches_total": (
+                    d["overlapped_dispatches_total"]
+                ),
+                "host_idle_fraction": d["host_idle_fraction"],
             }
         idx = self.prefix_index_stats()
         return {

@@ -47,6 +47,8 @@ class ServeMetrics:
                  mesh_devices: int = 1,
                  cache_pool_bytes_per_device: int = 0,
                  kv_dtype: str = "bf16",
+                 prefill_chunk: int = 0,
+                 async_host: bool = False,
                  namespace: str = ""):
         self.model = model
         self.slots = slots
@@ -73,6 +75,12 @@ class ServeMetrics:
         #: cache_pool_bytes_per_device so dashboards can attribute a
         #: bytes drop to quantization rather than a smaller pool
         self.kv_dtype = kv_dtype
+        #: chunked-prefill configuration (docs/PERFORMANCE.md "Chunked
+        #: prefill & async host loop"): the fixed chunk width (0 =
+        #: monolithic prefill) and whether the pipelined async host
+        #: loop is on — surfaced so a metrics line is self-describing
+        self.prefill_chunk = prefill_chunk
+        self.async_host = bool(async_host)
         self.registry = registry if registry is not None else MetricRegistry()
         r = self.registry
 
@@ -87,6 +95,15 @@ class ServeMetrics:
         self._stalled = r.counter(n("serve.stalled"))
         self._tokens_generated = r.counter(n("serve.tokens_generated"))
         self._prefills = r.counter(n("serve.prefills"))
+        # chunked prefill + async host loop (docs/PERFORMANCE.md):
+        # chunk dispatches (intermediate AND final) and decode blocks
+        # dispatched while the previous block was still in flight
+        self._chunked_prefills = r.counter(n("serve.chunked_prefills"))
+        self._overlapped = r.counter(n("serve.overlapped_dispatches"))
+        #: cumulative host seconds spent BLOCKED in a decode block's
+        #: device_get — host_idle_fraction's numerator, measured
+        #: identically in sync and async mode so the two are comparable
+        self.host_sync_wait_s = 0.0
         # resilience plane (docs/SERVING.md "Failure semantics"):
         # injected faults, retry absorptions, quarantines, preemptions
         self._retries = r.counter(n("serve.retries"))
@@ -130,6 +147,11 @@ class ServeMetrics:
         self.tick_seconds: list[float] = []
         self.ttft_ticks: list[int] = []
         self.ttft_s: list[float] = []
+        #: request id per ttft_s entry — first-token ARRIVAL order is
+        #: not submit order under chunked fills (short prompts finish
+        #: ahead of a long prompt's multi-chunk fill), so per-class
+        #: TTFT slicing (bench's long-vs-short split) needs the ids
+        self.ttft_req_ids: list[int] = []
         self.decode_seconds = 0.0
         self.decode_tokens = 0
         # length-aware decode accounting: KV rows the split-KV kernel
@@ -298,6 +320,14 @@ class ServeMetrics:
     def prefills(self) -> int:
         return self._prefills.value
 
+    @property
+    def chunked_prefills_total(self) -> int:
+        return self._chunked_prefills.value
+
+    @property
+    def overlapped_dispatches_total(self) -> int:
+        return self._overlapped.value
+
     # -- recording hooks (called by the engine) ---------------------------
 
     def _touch(self) -> None:
@@ -322,6 +352,7 @@ class ServeMetrics:
         self.ttft_ticks.append(tick - req.submit_tick)
         ttft = time.perf_counter() - req.submit_wall
         self.ttft_s.append(ttft)
+        self.ttft_req_ids.append(req.id)
         self._ttft_ms.record(ttft * 1e3)
         if self.slo is not None:
             self.slo.observe_ttft(ttft * 1e3)
@@ -373,6 +404,21 @@ class ServeMetrics:
         if self.slo is not None and result.status != "handed_off":
             self.slo.observe_finish(result.status == "completed")
         self._touch()
+
+    def record_prefill_chunk(self) -> None:
+        """One chunk dispatch of a chunked prefill (intermediate or
+        final)."""
+        self._chunked_prefills.inc()
+
+    def record_overlapped_dispatch(self) -> None:
+        """One decode block dispatched while the previous block was
+        still in flight (the async host loop's pipelining hit)."""
+        self._overlapped.inc()
+
+    def record_host_sync(self, seconds: float) -> None:
+        """Host seconds spent blocked in one decode block's
+        device_get."""
+        self.host_sync_wait_s += max(0.0, seconds)
 
     def record_fault(self, kind: str) -> None:
         """One injected fault (the injector's listener calls this)."""
@@ -539,6 +585,25 @@ class ServeMetrics:
                 if self.decode_dense_kv else None
             ),
             "prefill_buckets": dict(self.prefill_buckets),
+            # chunked prefill + async host loop (docs/PERFORMANCE.md
+            # "Chunked prefill & async host loop"; schema-gated):
+            # configuration echoes, chunk-dispatch volume, pipelining
+            # hits, and the fraction of tick wall time the host spent
+            # BLOCKED in decode-block device_gets — the figure
+            # --async-host exists to shrink (inert zeros/None on
+            # monolithic-synchronous engines, so the schema stays fixed)
+            "prefill_chunk": self.prefill_chunk,
+            "chunked_prefills_total": self.chunked_prefills_total,
+            "async_host": int(self.async_host),
+            "overlapped_dispatches_total": self.overlapped_dispatches_total,
+            "host_sync_wait_s": round(self.host_sync_wait_s, 4),
+            "host_idle_fraction": (
+                round(
+                    min(1.0, self.host_sync_wait_s
+                        / sum(self.tick_seconds)), 4
+                )
+                if sum(self.tick_seconds) > 0 else None
+            ),
             # fused decode blocks (docs/SERVING.md "Decode blocks"):
             # the configured max T, mean real tokens per tick, and how
             # often each ladder size actually ran

@@ -811,10 +811,12 @@ class MultiModelEngine:
 #: builder (everything else in an entry is build_model config)
 _DEPLOY_KEYS = frozenset({
     "slots", "cache_len", "decode_block", "max_queue", "max_batch",
-    "slo",
+    "slo", "prefill_chunk", "async_host",
 })
 #: deployment keys valid per kind — crossing them is a spec error
-_LM_ONLY = frozenset({"slots", "cache_len", "decode_block"})
+_LM_ONLY = frozenset({
+    "slots", "cache_len", "decode_block", "prefill_chunk", "async_host",
+})
 _BATCH_ONLY = frozenset({"max_batch"})
 
 
@@ -921,6 +923,7 @@ def engine_from_spec(spec: str, *, device_budget: int | None = None,
                      faults: FaultInjector | None = None,
                      registry: MetricRegistry | None = None,
                      variables: dict | None = None,
+                     lm_kwargs: dict | None = None,
                      seed: int = 0) -> MultiModelEngine:
     """Build a :class:`MultiModelEngine` from the CLI spec string.
 
@@ -966,8 +969,11 @@ def engine_from_spec(spec: str, *, device_budget: int | None = None,
         else:
             model_vars = _init_variables(graph, seed, dtype=input_dtype)
         if causal:
+            # lm_kwargs: CLI-wide LM defaults (e.g. --prefill-chunk /
+            # --async-host threading through --models); per-entry spec
+            # keys win
             engine.add_lm(entry.name, graph, model_vars,
-                          **entry.deploy_kwargs)
+                          **{**(lm_kwargs or {}), **entry.deploy_kwargs})
         else:
             engine.add_batch(entry.name, graph, model_vars,
                              **entry.deploy_kwargs)

@@ -315,6 +315,17 @@ class PagedCachePool:
             self.buffers[name] = entry
         self._free = list(range(slots - 1, -1, -1))
         self._leased: set[int] = set()
+        # deferred-free window (same contract as SlotCachePool): while
+        # the async engine has a decode block in flight, a freed slot's
+        # device row state AND page-table row reset immediately (so the
+        # NEXT dispatch writes to trash), but its free-list return and
+        # page refcount release wait until the stamped generation's
+        # block is fetched — the block already in flight writes through
+        # the OLD device page table it was dispatched with, so those
+        # pages must stay owned until its outputs materialize.
+        self._defer_gen: int | None = None
+        self._deferred: list[tuple[int, int, list[int]]] = []
+        self._deferred_slots: set[int] = set()
         self.positions = self._commit_slot(jnp.zeros((slots,), jnp.int32))
         self.live = self._commit_slot(jnp.zeros((slots,), bool))
 
@@ -541,15 +552,62 @@ class PagedCachePool:
         self._leased.add(slot)
         return slot
 
+    def defer_frees(self, gen: int) -> None:
+        """Open (or advance) a deferred-free window — see
+        :meth:`SlotCachePool.defer_frees`. The paged pool's split: the
+        slot's PAGE-TABLE row points at the trash page IMMEDIATELY (so
+        the next dispatch's dead-row writes are absorbed, exactly like
+        a synchronous free), but the pages' refcounts only drop at
+        :meth:`flush_frees` — the block already in flight writes
+        through the OLD device table it was dispatched with, so its
+        frontier page must stay owned until its outputs materialize."""
+        self._defer_gen = gen
+
+    def flush_frees(self, completed_gen: int | None = None) -> None:
+        """Decref the held pages and return the slot for every deferred
+        free whose stamped generation is ``<= completed_gen`` (all when
+        None, which also closes the window)."""
+        if completed_gen is None:
+            self._defer_gen = None
+        keep = []
+        for gen, slot, pages in self._deferred:
+            if completed_gen is None or gen <= completed_gen:
+                self._deferred_slots.discard(slot)
+                self._leased.discard(slot)
+                self._free.append(slot)
+                for pg in pages:
+                    self._decref(pg)
+            else:
+                keep.append((gen, slot, pages))
+        self._deferred = keep
+
     def free(self, slot: int) -> None:
-        if slot not in self._leased:
+        if slot not in self._leased or slot in self._deferred_slots:
             raise FriendlyError(
                 f"slot {slot} is not leased (double free, or never "
                 f"leased from this pool of {self.num_slots})"
             )
-        self._leased.remove(slot)
-        self._free.append(slot)
-        self._release_mappings(slot)
+        if self._defer_gen is not None:
+            # hold the refcounts, retarget the table: the deferred
+            # entry keeps the page ids alive past the in-flight block,
+            # while the trash-pointing row reaches every FUTURE
+            # dispatch through the commit below
+            pages = [
+                int(self._pt_host[slot, pg])
+                for pg in range(self._npages[slot])
+            ]
+            self._deferred.append((self._defer_gen, slot, pages))
+            self._deferred_slots.add(slot)
+            if self._npages[slot]:
+                self._pt_host[slot, :] = self._trash_page(
+                    self._shard_of_slot(slot)
+                )
+                self._npages[slot] = 0
+                self._pt_dirty = True
+        else:
+            self._leased.remove(slot)
+            self._free.append(slot)
+            self._release_mappings(slot)
         self._commit_pt()
         self._commit_slot_pair(
             self.positions.at[slot].set(0),

@@ -98,6 +98,26 @@ class _SlotState:
     first_token_tick: int = 0
 
 
+@dataclass
+class _FillState:
+    """Chunked-prefill state of one slot mid-fill (docs/SERVING.md
+    "Chunked prefill"): the request holds its slot lease while the
+    engine advances the fill frontier one chunk per tick; the slot
+    only joins the decode batch when ``filled`` reaches ``total``.
+    ``carry`` is engine-owned opaque state (the device carry cache) —
+    the scheduler stays pure host bookkeeping and never looks inside.
+    ``keep`` is the prefix-cache resume frontier: positions
+    ``[0, keep)`` came from a shared prefix and are already in the
+    carry, so chunking starts at ``keep``."""
+
+    req: ServeRequest
+    filled: int  # positions [0, filled) already computed into the carry
+    total: int  # = len(prompt) + len(prefix): the full fill target
+    keep: int = 0
+    started_tick: int = 0
+    carry: object = None
+
+
 class ContinuousBatchScheduler:
     def __init__(self, pool, max_queue: int):
         if max_queue < 1:
@@ -106,6 +126,9 @@ class ContinuousBatchScheduler:
         self.max_queue = max_queue
         self.queue: deque[ServeRequest] = deque()
         self.active: dict[int, _SlotState] = {}  # slot -> state
+        #: slot -> mid-fill chunked-prefill state (empty when the
+        #: engine runs monolithic prefill)
+        self.filling: dict[int, _FillState] = {}
         self.tick_count = 0
 
     @property
@@ -114,7 +137,7 @@ class ContinuousBatchScheduler:
 
     @property
     def busy(self) -> bool:
-        return bool(self.queue or self.active)
+        return bool(self.queue or self.active or self.filling)
 
     def enqueue(self, req: ServeRequest) -> None:
         """Admission control: the queue is BOUNDED — a full queue rejects
@@ -152,7 +175,30 @@ class ContinuousBatchScheduler:
                 del self.active[slot]
                 self.pool.free(slot)
                 out.append(self._finish(st, "expired", tick))
+        for slot, fs in list(self.filling.items()):
+            req = fs.req
+            if req.deadline_tick is not None and tick >= req.deadline_tick:
+                del self.filling[slot]
+                self.pool.free(slot)
+                out.append(self._queued_result(req, "expired", tick))
         return out
+
+    # -- chunked prefill (docs/SERVING.md "Chunked prefill") ---------------
+
+    def start_fill(self, slot: int, req: ServeRequest, total: int,
+                   keep: int, carry, tick: int) -> _FillState:
+        """Begin a chunked fill in a freshly leased slot: the request
+        leaves the queue and holds the slot while the engine's fill
+        loop advances ``filled`` from ``keep`` toward ``total``."""
+        fs = _FillState(req=req, filled=keep, total=total, keep=keep,
+                        started_tick=tick, carry=carry)
+        self.filling[slot] = fs
+        return fs
+
+    def fill_done(self, slot: int) -> _FillState:
+        """Pop a completed (or abandoned) fill; the caller activates
+        the request, hands it off, or frees the slot."""
+        return self.filling.pop(slot)
 
     def activate(self, slot: int, req: ServeRequest, first_token: int,
                  tick: int) -> RequestResult | None:
@@ -211,7 +257,8 @@ class ContinuousBatchScheduler:
         return tok, rem, eos, min_rem
 
     def consume(
-        self, token_block: np.ndarray, tick: int
+        self, token_block: np.ndarray, tick: int,
+        states: dict[int, _SlotState] | None = None,
     ) -> tuple[list[RequestResult], dict[int, int]]:
         """Fold one fused decode BLOCK's ``(S, T)`` token output back
         into per-slot state: each active slot consumes its row left to
@@ -220,13 +267,23 @@ class ContinuousBatchScheduler:
         for the next tick's admissions. A ``(S,)`` vector is accepted as
         a T=1 block. Returns ``(finished results, {slot: real tokens
         consumed})`` — the consumed counts are what per-token metrics
-        divide by."""
+        divide by.
+
+        ``states`` is the async engine's identity fence: the slot->state
+        map captured AT DISPATCH. A block fetched one tick late must
+        only feed rows whose slot still holds the SAME request — a slot
+        retired after dispatch (expiry, quarantine, cancel, preemption)
+        and possibly re-leased to a new tenant contributes device pads
+        that belong to nobody, so those rows are dropped."""
         token_block = np.asarray(token_block)
         if token_block.ndim == 1:
             token_block = token_block[:, None]
         finished: list[RequestResult] = []
         consumed: dict[int, int] = {}
-        for slot, st in list(self.active.items()):
+        rows = self.active if states is None else states
+        for slot, st in list(rows.items()):
+            if states is not None and self.active.get(slot) is not st:
+                continue
             req = st.req
             taken = 0
             for col in range(token_block.shape[1]):
@@ -300,6 +357,11 @@ class ContinuousBatchScheduler:
                 del self.active[slot]
                 self.pool.free(slot)
                 return len(st.out)
+        for slot, fs in list(self.filling.items()):
+            if fs.req.id == request_id:
+                del self.filling[slot]
+                self.pool.free(slot)
+                return len(fs.req.prefix)
         return None
 
     def handoff_all(self) -> list[ServeRequest]:
@@ -309,6 +371,14 @@ class ContinuousBatchScheduler:
         continues each stream bit-identically), then the queue in FIFO
         order. Zero-loss drain's request hand-off."""
         out = [self.preempt(slot) for slot in sorted(self.active)]
+        # mid-fill requests migrate as plain queued entries (their
+        # resume prefix is unchanged — no tokens were emitted); the
+        # fill restarts from scratch on the adopting replica, which is
+        # deterministic, so the eventual stream is bit-identical
+        for slot in sorted(self.filling):
+            fs = self.filling.pop(slot)
+            self.pool.free(slot)
+            out.append(fs.req)
         while self.queue:
             out.append(self.queue.popleft())
         return out
@@ -345,6 +415,10 @@ class ContinuousBatchScheduler:
             self.pool.free(slot)
             out.append(self._finish(st, "stalled", tick))
         self.active.clear()
+        for slot, fs in sorted(self.filling.items()):
+            self.pool.free(slot)
+            out.append(self._queued_result(fs.req, "stalled", tick))
+        self.filling.clear()
         return out
 
     # -- result assembly ---------------------------------------------------

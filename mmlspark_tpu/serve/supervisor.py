@@ -845,6 +845,11 @@ class ReplicaSet:
                 "prefill_compile_count": (
                     rep.engine.prefill_compile_count
                 ),
+                "chunked_prefills_total": d["chunked_prefills_total"],
+                "overlapped_dispatches_total": (
+                    d["overlapped_dispatches_total"]
+                ),
+                "host_idle_fraction": d["host_idle_fraction"],
             }
         return {
             "replicas": len(self._reps),

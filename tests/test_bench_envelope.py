@@ -95,7 +95,8 @@ def test_scratch_merge_roundtrip_and_missing_groups(monkeypatch, tmp_path):
         "stage", "resnet50", "train", "trees", "flash", "flash_long",
         "int8_serving", "feed_synth", "decode", "serve", "serve_paged",
         "serve_int8", "serve_sharded", "serve_faults", "serve_supervisor",
-        "serve_disagg", "serve_multimodel", "train_resilience", "integrity",
+        "serve_disagg", "serve_multimodel", "serve_chunked",
+        "train_resilience", "integrity",
     }
     # merge is a real file round-trip: a fresh load sees the update
     with open(os.environ["MMLTPU_BENCH_SCRATCH"], encoding="utf-8") as f:

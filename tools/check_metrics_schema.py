@@ -97,6 +97,18 @@ REQUIRED_METRIC_KEYS: dict[str, tuple] = {
     "decode_dense_kv_tokens": (int,),
     "decode_flop_utilization": NUM,
     "prefill_buckets": (dict,),
+    # chunked prefill + async host loop (docs/PERFORMANCE.md "Chunked
+    # prefill & async host loop"): always present — a monolithic/sync
+    # engine reports prefill_chunk=0, the counters 0 and async_host=0,
+    # so dashboards can alert on host_idle_fraction growth without
+    # existence checks. host_idle_fraction is null only on a run with
+    # no ticks; the demo run below always populates it
+    "prefill_chunk": (int,),
+    "chunked_prefills_total": (int,),
+    "async_host": (int,),
+    "overlapped_dispatches_total": (int,),
+    "host_sync_wait_s": NUM,
+    "host_idle_fraction": NUM,
     # the telemetry plane's additions
     "ttft_ms_p50": NUM,
     "ttft_ms_p95": NUM,
@@ -223,6 +235,11 @@ REQUIRED_PER_REPLICA_KEYS: dict[str, tuple] = {
     "queue_depth": (int,),
     "decode_compile_count": (int,),
     "prefill_compile_count": (int,),
+    # chunked-prefill/async rollups per replica: a fleet where only the
+    # prefill role chunks must show WHERE the chunking happened
+    "chunked_prefills_total": (int,),
+    "overlapped_dispatches_total": (int,),
+    "host_idle_fraction": NUM + (type(None),),
 }
 
 # the --disagg JSON line is DisaggFleet.metrics_dict() (docs/SERVING.md
@@ -605,6 +622,10 @@ def check_replica_mode(env: dict, repo: str) -> None:
             "serve", "--demo", "--slots", "2",
             "--requests", str(N_REQUESTS), "--max-new-tokens", "4",
             "--replicas", "2", "--hedge-ms", "50",
+            # chunked + async through the SUPERVISOR: every replica
+            # engine inherits the flags, and the hub bundle's detect()
+            # pass below must stay quiet on this healthy async run
+            "--prefill-chunk", "8", "--async-host",
             "--telemetry-dir", tdir,
         ]
         res = subprocess.run(
@@ -670,10 +691,34 @@ def check_replica_mode(env: dict, repo: str) -> None:
                        'serve_ttft_ms_count{replica="0"}'):
             if needle not in prom:
                 fail(f"--replicas metrics.prom lacks {needle!r}")
+        # the replicas split the traffic, but the fleet as a whole must
+        # have chunked SOMETHING — a zero sum means the supervisor
+        # dropped the engine kwargs
+        if not sum(
+            sub["chunked_prefills_total"]
+            for sub in md["per_replica"].values()
+        ) > 0:
+            fail(
+                "--replicas with --prefill-chunk: no replica reports "
+                "chunked_prefills_total > 0"
+            )
         lines = check_hub_bundle(
             tdir, "--replicas",
             ("hub", "supervisor", "replica0", "replica1"),
         )
+        # the healthy-async-run contract (docs/PERFORMANCE.md "Chunked
+        # prefill & async host loop"): pipelining must not smear the
+        # tick-time distribution — the hub's tick_p99_drift detector
+        # (write_bundle runs one detect() pass) stays QUIET
+        hub_block = json.load(
+            open(os.path.join(tdir, "metrics.json"), encoding="utf-8")
+        ).get("hub", {})
+        drift = hub_block.get("alerts", {}).get("tick_p99_drift")
+        if drift != 0:
+            fail(
+                "--replicas --async-host: a healthy async run must keep "
+                f"the tick_p99_drift detector quiet, got {drift!r}"
+            )
         if not os.path.exists(
                 os.path.join(tdir, "supervisor.events.jsonl")):
             fail("--replicas bundle lacks the supervisor.events.jsonl "
@@ -701,6 +746,10 @@ def check_disagg_mode(env: dict, repo: str) -> None:
             "--disagg", "--prefill-replicas", "1",
             "--decode-replicas", "2",
             "--autoscale", "max_decode=3,queue_high=8",
+            # chunked backlogs on the PREFILL role (docs/SERVING.md
+            # "Disaggregated serving"): the per-replica rollup below
+            # must attribute the chunking to the prefill replica
+            "--prefill-chunk", "8",
             "--telemetry-dir", tdir,
         ]
         res = subprocess.run(
@@ -772,6 +821,25 @@ def check_disagg_mode(env: dict, repo: str) -> None:
                         f"per_replica.{rname}: key {key!r} has type "
                         f"{type(sub[key]).__name__}, expected one of "
                         f"{[t.__name__ for t in types]}"
+                    )
+        # --prefill-chunk on a fleet: ONLY the prefill role fills, so
+        # the chunk counter must land on prefill replicas and stay 0 on
+        # decode replicas (which adopt finished KV, never filling)
+        for rname, sub in md["per_replica"].items():
+            if sub["role"] == "prefill" and sub["submitted"] > 0:
+                if not sub["chunked_prefills_total"] > 0:
+                    fail(
+                        f"per_replica.{rname}: a prefill-role replica "
+                        "that admitted requests under --prefill-chunk "
+                        "must report chunked_prefills_total > 0"
+                    )
+            if sub["role"] == "decode":
+                if sub["chunked_prefills_total"] != 0:
+                    fail(
+                        f"per_replica.{rname}: a decode-role replica "
+                        "adopting hand-offs must report "
+                        "chunked_prefills_total == 0, got "
+                        f"{sub['chunked_prefills_total']}"
                     )
         # the bundle is the fleet's: hand-off/index/autoscale counters
         # in the exposition, routing events in the timeline
@@ -1418,6 +1486,11 @@ def main() -> None:
             # populated form — page_utilization must be a number here,
             # not the dense pool's null
             "--paged",
+            # chunked prefill + async host loop (docs/PERFORMANCE.md
+            # "Chunked prefill & async host loop") stacked on the mesh
+            # + paged run: the gate pins the populated form of the new
+            # keys AND that the full flag combination keeps serving
+            "--prefill-chunk", "8", "--async-host",
             "--telemetry-dir", tdir,
             # generous targets: the SLO plane runs (declared state,
             # window arithmetic, per-tick evaluation) without actually
@@ -1466,6 +1539,29 @@ def main() -> None:
                 "stdout: a --paged run must report numeric "
                 f"page_utilization, got "
                 f"{stdout_metrics.get('page_utilization')!r}"
+            )
+        # chunked/async populated form: the run passed both flags, so
+        # the inert defaults (0 everywhere) would mean the CLI dropped
+        # them on the floor
+        if stdout_metrics.get("prefill_chunk") != 8:
+            fail(
+                "stdout: a --prefill-chunk 8 run must report "
+                f"prefill_chunk == 8, got "
+                f"{stdout_metrics.get('prefill_chunk')!r}"
+            )
+        if stdout_metrics.get("async_host") != 1:
+            fail("stdout: an --async-host run must report async_host == 1")
+        if not stdout_metrics.get("chunked_prefills_total", 0) > 0:
+            fail(
+                "stdout: a chunked run that admitted requests must "
+                "report positive chunked_prefills_total, got "
+                f"{stdout_metrics.get('chunked_prefills_total')!r}"
+            )
+        if not isinstance(stdout_metrics.get("host_idle_fraction"), NUM):
+            fail(
+                "stdout: a run with ticks must report numeric "
+                "host_idle_fraction, got "
+                f"{stdout_metrics.get('host_idle_fraction')!r}"
             )
 
         mpath = os.path.join(tdir, "metrics.json")

@@ -102,6 +102,9 @@ len(mmlspark_tpu.all_stages()), 'stages')")
   step "quantized decode gate (int8 KV + weight-only int8 vs the bf16 oracle)"
   python -m pytest tests/test_quantized_serve.py -q
 
+  step "chunked prefill + async host gate (parity, compile pins, sync budget)"
+  python -m pytest tests/test_chunked_async.py -q
+
   step "disagg gate (prefill/decode fleet: hand-off, prefix index, autoscaler)"
   python -m pytest tests/test_serve_fleet.py -q
   python tools/check_metrics_schema.py --disagg
